@@ -1,0 +1,105 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capability surface of the reference
+(PaddlePaddle ~2.2/2.3-dev snapshot at /root/reference, see SURVEY.md),
+re-designed TPU-first: eager tensors + tape autograd over JAX/XLA, a jitted
+program path, Fleet-style hybrid parallelism compiled to GSPMD/shard_map over
+a `jax.sharding.Mesh`, and native C++ runtime components where the reference
+is native.
+
+Top-level namespace mirrors `import paddle`.
+"""
+from __future__ import annotations
+
+# Paddle semantics: int64 indices/labels, explicit float management. JAX's
+# x64-off mode silently truncates to int32, so enable it; every float path in
+# this package passes dtypes explicitly (default float32 / bf16 on MXU).
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+# fp32 means fp32 (reference kernel semantics): without this, f32 matmuls
+# drop to bf16 passes on MXU-like backends. The perf path uses real bf16
+# dtypes (AMP), which is unaffected by this setting.
+_jax.config.update("jax_default_matmul_precision", "highest")
+
+# Core types -----------------------------------------------------------------
+from .core.dtype import (  # noqa: F401
+    bool_ as bool,  # type: ignore[misc]
+    uint8, int8, int16, int32, int64,
+    float16, bfloat16, float32, float64, complex64, complex128,
+    set_default_dtype, get_default_dtype,
+)
+from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .core.place import (  # noqa: F401
+    CPUPlace, TPUPlace, CUDAPlace, Place,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from .core.engine import no_grad, enable_grad, set_grad_enabled, grad_enabled  # noqa: F401
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+
+# Ops (also monkey-patches Tensor methods) -----------------------------------
+from . import ops as _ops  # noqa: F401
+from .ops.creation import (  # noqa: F401
+    zeros, ones, full, empty, zeros_like, ones_like, full_like, empty_like,
+    arange, linspace, logspace, eye, diag, diagflat, tril, triu, meshgrid,
+    assign, clone, numel, rand, randn, randint, randint_like, randperm,
+    uniform, normal, gaussian, standard_normal, bernoulli, multinomial,
+    shard_index,
+)
+from .ops.math import (  # noqa: F401
+    add, subtract, multiply, divide, floor_divide, remainder, mod, pow,
+    maximum, minimum, fmax, fmin, atan2, exp, expm1, log, log2, log10, log1p,
+    sqrt, rsqrt, abs, sign, floor, ceil, round, trunc, frac, sin, cos, tan,
+    asin, acos, atan, sinh, cosh, tanh, asinh, acosh, atanh, erf, erfinv,
+    reciprocal, square, digamma, lgamma, sigmoid, clip, lerp, nan_to_num,
+    stanh, isnan, isinf, isfinite, equal, not_equal, greater_than,
+    greater_equal, less_than, less_equal, logical_and, logical_or,
+    logical_not, logical_xor, bitwise_and, bitwise_or, bitwise_xor,
+    bitwise_not, equal_all, allclose, isclose, sum, mean, max, min, prod,
+    amax, amin, all, any, std, var, median, quantile, nanmean, nansum,
+    logsumexp, argmax, argmin, cumsum, cumprod, cummax, cummin, logcumsumexp,
+    matmul, mm, dot, inner, outer, addmm, bmm, kron, trace, diagonal, mv,
+    dist, cast, scale, increment, neg, heaviside, hypot, copysign, nextafter,
+    gcd, lcm, ldexp,
+)
+from .ops.manipulation import (  # noqa: F401
+    reshape, reshape_, transpose, t, concat, stack, split, chunk, unbind,
+    unstack, squeeze, unsqueeze, flatten, expand, expand_as, broadcast_to,
+    broadcast_shape, broadcast_tensors, tile, repeat_interleave, flip, roll,
+    rot90, gather, gather_nd, take_along_axis, put_along_axis, scatter,
+    scatter_nd, scatter_nd_add, index_select, index_sample, index_add,
+    masked_select, masked_fill, where, nonzero, slice, strided_slice, crop,
+    topk, sort, argsort, searchsorted, unique, unique_consecutive, bincount,
+    histogram, atleast_1d, atleast_2d, atleast_3d, as_real, as_complex, real,
+    imag, conj, moveaxis, swapaxes,
+)
+
+from . import linalg  # noqa: F401
+from . import autograd  # noqa: F401
+from .autograd import grad  # noqa: F401
+from . import device  # noqa: F401
+from .device import set_device, get_device  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .framework.flags import set_flags, get_flags  # noqa: F401
+from . import distributed  # noqa: F401
+from . import incubate  # noqa: F401
+from . import profiler  # noqa: F401
+from .hapi.model import Model, summary  # noqa: F401
+from . import distribution  # noqa: F401
+
+from .io import DataLoader  # noqa: F401
+from .nn.layer.common import ParameterList  # noqa: F401
+
+disable_static = lambda *a, **k: None  # eager is the default (reference: paddle.disable_static)
+enable_static = lambda *a, **k: None
+in_dynamic_mode = lambda: True
+
+__version__ = "0.1.0"

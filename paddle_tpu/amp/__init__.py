@@ -1,0 +1,210 @@
+"""AMP — automatic mixed precision.
+
+Parity: reference dygraph AMP (``python/paddle/fluid/dygraph/amp/auto_cast.py``
+O1/O2 op lists, ``paddle/fluid/imperative/amp_auto_cast.*`` tracer casts;
+``paddle.amp.GradScaler`` over check_finite_and_unscale/update_loss_scaling
+ops). TPU-native: bf16 is the default low-precision dtype (MXU-native, no
+loss scaling needed); fp16 + dynamic loss scaling is kept for parity.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+# O1 lists (reference fluid/dygraph/amp/auto_cast.py:33-79)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm", "addmm",
+    "scaled_dot_product_attention", "einsum",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "c_softmax_with_cross_entropy", "layer_norm", "norm",
+    "batch_norm", "group_norm", "instance_norm", "logsumexp", "erf", "erfinv",
+    "log_softmax", "mse_loss", "l1_loss", "nll_loss", "bce", "bce_with_logits",
+}
+
+
+class _AmpState:
+    enabled = False
+    dtype = dtypes.bfloat16
+    level = "O1"
+
+
+_state = _AmpState()
+
+
+def _cast_tensors(tensors, dt):
+    out = []
+    for t in tensors:
+        if dtypes.is_floating_point(t.dtype) and t.dtype != dt:
+            from ..ops.math import cast
+
+            out.append(cast(t, dt))
+        else:
+            out.append(t)
+    return out
+
+
+def _amp_hook(op_name, tensors):
+    if not _state.enabled:
+        return tensors
+    if _state.level == "O2":
+        if op_name in BLACK_LIST:
+            return _cast_tensors(tensors, dtypes.float32)
+        return _cast_tensors(tensors, _state.dtype)
+    # O1: white list → low precision; black list → fp32; else follow inputs
+    if op_name in WHITE_LIST:
+        return _cast_tensors(tensors, _state.dtype)
+    if op_name in BLACK_LIST:
+        return _cast_tensors(tensors, dtypes.float32)
+    return tensors
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast (reference amp_guard fluid/dygraph/amp/auto_cast.py:196)."""
+    prev = (_state.enabled, _state.dtype, _state.level)
+    prev_white = set(WHITE_LIST)
+    prev_black = set(BLACK_LIST)
+    if custom_white_list:
+        WHITE_LIST.update(custom_white_list)
+        BLACK_LIST.difference_update(custom_white_list)
+    if custom_black_list:
+        BLACK_LIST.update(custom_black_list)
+        WHITE_LIST.difference_update(custom_black_list)
+    _state.enabled = enable
+    _state.dtype = dtypes.convert_dtype(dtype)
+    _state.level = level
+    dispatch.set_amp_hook(_amp_hook if enable else None)
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level = prev
+        WHITE_LIST.clear()
+        WHITE_LIST.update(prev_white)
+        BLACK_LIST.clear()
+        BLACK_LIST.update(prev_black)
+        dispatch.set_amp_hook(_amp_hook if _state.enabled else None)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """Cast model params to low precision for O2 (reference amp_decorate)."""
+    dt = dtypes.convert_dtype(dtype)
+    singleton = not isinstance(models, (list, tuple))
+    model_list = [models] if singleton else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dt)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference paddle/amp/grad_scaler.py:26 backed by
+    check_finite_and_unscale + update_loss_scaling ops)."""
+
+    def __init__(
+        self,
+        enable=True,
+        init_loss_scaling=2.0**15,
+        incr_ratio=2.0,
+        decr_ratio=0.5,
+        incr_every_n_steps=1000,
+        decr_every_n_nan_or_inf=2,
+        use_dynamic_loss_scaling=True,
+    ):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None:
+                g = p.grad._data.astype(jnp.float32) / self._scale
+                found = bool(found or not bool(jnp.isfinite(g).all()))
+                p.grad._set_data(g.astype(p.grad._data.dtype) if p.grad._data.dtype != jnp.float32 else g)
+        self._found_inf = found
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_scale(self):
+        return self._scale
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+    set_state_dict = load_state_dict

@@ -1,0 +1,191 @@
+"""paddle.autograd parity.
+
+Reference: ``python/paddle/autograd/`` — ``backward``, functional ``grad``
+(C++ PartialGradEngine, ``paddle/fluid/imperative/partial_grad_engine.cc``)
+and ``PyLayer`` custom autograd (``python/paddle/autograd/py_layer.py``).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+
+from ..core.engine import run_backward, no_grad, enable_grad, set_grad_enabled  # noqa: F401
+from ..core.engine import GradNode
+from ..core.tensor import Tensor
+from ..core.dispatch import as_tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad — partial gradients without touching ``.grad`` slots."""
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    capture = {id(t): t for t in inputs}
+    captured = run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=bool(retain_graph) or create_graph,
+        capture=capture,
+        accumulate_leaves=False,
+        create_graph=create_graph,
+    )
+    results = []
+    for t in inputs:
+        g = captured.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"Tensor {t.name} is unreachable from outputs (set allow_unused=True to return None)"
+                )
+            results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (reference py_layer.py:PyLayer).
+
+    Subclass with static ``forward(ctx, *args)`` and ``backward(ctx, *grads)``
+    operating on Tensors.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.engine import grad_enabled
+
+        ctx = PyLayerContext()
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = isinstance(outputs, Tensor)
+        outs = [outputs] if single else list(outputs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        need_grad = grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        if not need_grad:
+            return outputs
+
+        def vjp_fn(cts):
+            if not isinstance(cts, tuple):
+                cts = (cts,)
+            ct_tensors = [Tensor(c, stop_gradient=True) for c in cts]
+            with no_grad():
+                grads = cls.backward(ctx, *ct_tensors)
+            if isinstance(grads, Tensor) or grads is None:
+                grads = (grads,)
+            out = []
+            gi = iter(grads)
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = next(gi, None)
+                    out.append(None if g is None else g._data)
+            return tuple(out)
+
+        routes = []
+        for t in tensor_inputs:
+            if t.stop_gradient:
+                routes.append(None)
+            elif t._grad_node is not None:
+                routes.append(("node", t._grad_node, t._out_index))
+            else:
+                routes.append(("leaf", t))
+        out_avals = [(tuple(o._data.shape), o._data.dtype) for o in outs]
+        node = GradNode(cls.__name__, vjp_fn, routes, out_avals, multi=not single)
+        import weakref
+
+        refs = []
+        for i, o in enumerate(outs):
+            o.stop_gradient = False
+            o._grad_node = node
+            o._out_index = i
+            refs.append(weakref.ref(o))
+        node.out_tensors = refs
+        return outputs
+
+
+def is_grad_enabled():
+    from ..core.engine import grad_enabled
+
+    return grad_enabled()
+
+
+# Functional jacobian/hessian (reference: paddle.autograd.functional)
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    single = isinstance(xs, Tensor)
+    xs_l = [xs] if single else list(xs)
+    arrays = [t._data for t in xs_l]
+
+    def f(*arrs):
+        ts = [Tensor(a, stop_gradient=False) for a in arrs]
+        out = func(ts[0] if single else ts)
+        return out._data if isinstance(out, Tensor) else out
+
+    jac = jax.jacrev(f, argnums=tuple(range(len(arrays))))(*arrays)
+    if single:
+        return Tensor(jac[0])
+    return tuple(Tensor(j) for j in jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    single = isinstance(xs, Tensor)
+    xs_l = [xs] if single else list(xs)
+    arrays = [t._data for t in xs_l]
+
+    def f(*arrs):
+        ts = [Tensor(a, stop_gradient=False) for a in arrs]
+        out = func(ts[0] if single else ts)
+        return out._data if isinstance(out, Tensor) else out
+
+    hess = jax.hessian(f, argnums=tuple(range(len(arrays))))(*arrays)
+    if single:
+        return Tensor(hess[0][0])
+    return tuple(tuple(Tensor(h) for h in row) for row in hess)

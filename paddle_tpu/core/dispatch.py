@@ -1,0 +1,195 @@
+"""Eager op dispatch.
+
+TPU-native analogue of the reference's dygraph trace path
+(``paddle/fluid/imperative/tracer.cc:170`` TraceOp →
+``prepared_operator.cc:129`` kernel select → launch). Here "kernel selection"
+is gone — every op is a pure JAX function lowered by XLA — and the trace step
+is a ``jax.vjp`` capture that doubles as grad-node creation
+(cf. tracer.cc:303 CreateGradOpNode). Non-differentiable paths run through a
+per-op ``jax.jit`` cache so repeated eager calls hit compiled executables.
+
+AMP auto-cast hooks into this layer exactly where the reference casts inputs
+in the tracer (tracer.cc:207-221).
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import jax
+
+from .engine import GradNode, grad_enabled
+from .tensor import Tensor
+
+# AMP hook — set by paddle_tpu.amp.auto_cast; signature (op_name, tensors) -> tensors
+_amp_hook: Optional[Callable] = None
+
+
+def set_amp_hook(hook):
+    global _amp_hook
+    _amp_hook = hook
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, np.dtype):
+        return str(v)
+    return v
+
+
+# Per-(op, attrs) jitted executable cache — the analogue of the reference's
+# PreparedOp cache (prepared_operator.cc) + program/executable caching.
+# Ops define their fn as a per-call lambda/closure, so the key must be the
+# code object + closure/default VALUES, not the function identity — otherwise
+# every call is a cache miss and the cache grows without bound.
+import collections
+
+_jit_cache: "collections.OrderedDict" = collections.OrderedDict()
+_JIT_CACHE_MAX = 4096
+
+
+def _fn_key(fn):
+    try:
+        cells = tuple(c.cell_contents for c in (getattr(fn, "__closure__", None) or ()))
+        defaults = getattr(fn, "__defaults__", None) or ()
+        kwdefaults = tuple(sorted((getattr(fn, "__kwdefaults__", None) or {}).items()))
+        code = getattr(fn, "__code__", None)
+        key = (code, cells, defaults, kwdefaults) if code is not None else fn
+        hash(key)
+        return key
+    except (TypeError, ValueError, AttributeError):
+        return fn  # unhashable closure → identity key (no sharing, still cached)
+
+
+def _get_jitted(fn, attrs):
+    try:
+        key = (_fn_key(fn), tuple(sorted((k, _hashable(v)) for k, v in attrs.items())))
+        hash(key)
+    except TypeError:  # unhashable attr → run eagerly un-jitted
+        return lambda *arrays: fn(*arrays, **attrs)
+    jf = _jit_cache.get(key)
+    if jf is None:
+        jf = jax.jit(lambda *arrays: fn(*arrays, **attrs))
+        _jit_cache[key] = jf
+        if len(_jit_cache) > _JIT_CACHE_MAX:
+            _jit_cache.popitem(last=False)
+    else:
+        _jit_cache.move_to_end(key)
+    return jf
+
+
+def eager_call(
+    name: str,
+    fn: Callable,
+    tensor_args: Sequence[Tensor],
+    attrs: Optional[dict] = None,
+    differentiable: bool = True,
+    nondiff_outputs: Sequence[int] = (),
+):
+    """Run one op eagerly; record a GradNode if any input needs grad.
+
+    ``fn(*arrays, **attrs)`` must be a pure function of JAX arrays returning
+    an array or a tuple of arrays. ``nondiff_outputs`` marks integer/bool
+    output positions excluded from the vjp capture.
+    """
+    attrs = attrs or {}
+    if _amp_hook is not None:
+        tensor_args = _amp_hook(name, tensor_args)
+    arrays = tuple(t._data for t in tensor_args)
+    need_grad = (
+        differentiable
+        and grad_enabled()
+        and any(not t.stop_gradient for t in tensor_args)
+    )
+
+    if not need_grad:
+        outs = _get_jitted(fn, attrs)(*arrays)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = [Tensor(o, stop_gradient=True) for o in ((outs,) if single else outs)]
+        return outs_t[0] if single else outs_t
+
+    if nondiff_outputs:
+        nondiff = set(nondiff_outputs)
+
+        # has_aux carries the nondiff outputs out of one forward execution
+        # (no double compute); we need the output count first — probe cheaply
+        # with eval_shape (no FLOPs).
+        probe = jax.eval_shape(lambda *xs: fn(*xs, **attrs), *arrays)
+        n_out = len(probe) if isinstance(probe, (tuple, list)) else 1
+        diff_idx = [i for i in range(n_out) if i not in nondiff]
+
+        def split_fn(*xs):
+            res = fn(*xs, **attrs)
+            res = res if isinstance(res, (tuple, list)) else (res,)
+            return tuple(res[i] for i in diff_idx), tuple(res[i] for i in sorted(nondiff))
+
+        diff_outs, vjp_fn, aux = jax.vjp(split_fn, *arrays, has_aux=True)
+        outs = [None] * n_out
+        for j, i in enumerate(diff_idx):
+            outs[i] = diff_outs[j]
+        for j, i in enumerate(sorted(nondiff)):
+            outs[i] = aux[j]
+        node_out_idx = {i: j for j, i in enumerate(diff_idx)}
+        multi = True
+        diff_list = list(diff_outs)
+    else:
+        # jax.vjp natively handles tuple outputs: cotangent structure matches.
+        outs, vjp_fn = jax.vjp(lambda *xs: fn(*xs, **attrs), *arrays)
+        multi = isinstance(outs, (tuple, list))
+        outs = list(outs) if multi else [outs]
+        node_out_idx = {i: i for i in range(len(outs))}
+        diff_list = outs
+
+    routes = []
+    for t in tensor_args:
+        if t.stop_gradient:
+            routes.append(None)
+        elif t._grad_node is not None:
+            routes.append(("node", t._grad_node, t._out_index))
+        else:
+            routes.append(("leaf", t))
+
+    out_avals = [(tuple(o.shape), o.dtype) for o in diff_list]
+    node = GradNode(name, vjp_fn, routes, out_avals, multi=multi)
+    # Replay info for higher-order grads (create_graph): backward is re-run as
+    # a recorded op over the ORIGINAL input tensors so d(grad)/d(input) exists.
+    if nondiff_outputs:
+        diff_fn = diff_only
+    else:
+        diff_fn = lambda *xs: fn(*xs, **attrs)
+    node.replay = (diff_fn, list(tensor_args), multi)
+
+    outs_t = []
+    refs = [None] * len(out_avals)
+    for i, o in enumerate(outs):
+        if i in node_out_idx:
+            t = Tensor(o, stop_gradient=False)
+            t._grad_node = node
+            t._out_index = node_out_idx[i]
+            refs[node_out_idx[i]] = weakref.ref(t)
+        else:
+            t = Tensor(o, stop_gradient=True)
+        outs_t.append(t)
+    node.out_tensors = refs
+    if len(outs_t) == 1 and not multi:
+        return outs_t[0]
+    return outs_t
+
+
+def as_tensor(x, dtype=None):
+    """Coerce scalars / numpy arrays / Tensors to Tensor (no copy when Tensor)."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x, dtype=dtype)
+
+
+def unary(name, fn, x, **attrs):
+    return eager_call(name, fn, [as_tensor(x)], attrs)
+
+
+def binary(name, fn, x, y, **attrs):
+    return eager_call(name, fn, [as_tensor(x), as_tensor(y)], attrs)

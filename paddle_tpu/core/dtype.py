@@ -1,0 +1,102 @@
+"""Dtype system.
+
+TPU-native analogue of the reference's ``paddle/phi/common/data_type.h`` /
+``python/paddle/fluid/core.VarDesc.VarType`` dtype enums: instead of a protobuf
+enum we alias numpy/JAX dtypes directly, keeping paddle-style names
+(``paddle.float32`` etc.) so user code reads identically.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype objects (numpy dtypes; JAX accepts them everywhere).
+bool_ = np.dtype("bool")
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = jnp.bfloat16.dtype  # ml_dtypes bfloat16 — first-class on TPU (MXU-native)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {uint8, int8, int16, int32, int64}
+_COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype):
+    """Normalize any user-supplied dtype spec to a numpy/ml_dtypes dtype.
+
+    Mirrors the reference's ``convert_dtype``
+    (``python/paddle/fluid/data_feeder.py``) but without the VarType enum hop.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = dtype.replace("paddle.", "")
+        if name not in _NAME_TO_DTYPE:
+            raise TypeError(f"Unsupported dtype string: {dtype!r}")
+        return _NAME_TO_DTYPE[name]
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        pass
+    if hasattr(dtype, "dtype"):
+        return np.dtype(dtype.dtype)
+    raise TypeError(f"Unsupported dtype: {dtype!r}")
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INTEGER
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in _COMPLEX
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    for name, v in _NAME_TO_DTYPE.items():
+        if v == d:
+            return name
+    return str(d)
+
+
+# Default dtype handling (paddle.get_default_dtype / set_default_dtype).
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError("set_default_dtype only accepts floating dtypes")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,297 @@
+"""Eager reverse-mode autograd engine.
+
+TPU-native analogue of the reference's dygraph autograd:
+``paddle/fluid/imperative/basic_engine.h:31`` (BasicEngine: ready-queue over
+grad nodes with dependency counting) and ``gradient_accumulator.h:28``
+(multi-consumer gradient summation). Instead of registered grad ops, each
+forward op captures a ``jax.vjp`` closure at trace time; backward replays the
+closures in reverse topological order. ``paddle.grad`` -style partial grads
+(reference ``partial_grad_engine.cc``) are supported via cotangent capture,
+and ``create_graph=True`` re-records the backward as tape ops over the
+original inputs so higher-order gradients work.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# --------------------------------------------------------------------------
+# Grad-enabled state (reference: tracer has_grad flag / paddle.no_grad)
+# --------------------------------------------------------------------------
+_grad_state = threading.local()
+
+
+def grad_enabled() -> bool:
+    return getattr(_grad_state, "enabled", True)
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _grad_state.enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager + decorator disabling autograd recording."""
+
+    def __enter__(self):
+        self._prev = grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+# --------------------------------------------------------------------------
+# Graph nodes
+# --------------------------------------------------------------------------
+class GradNode:
+    """One recorded op: holds the vjp closure and routing to its inputs.
+
+    ``input_routes[i]`` describes where the i-th input cotangent flows:
+      - ``("leaf", tensor)``      : accumulate into tensor.grad
+      - ``("node", node, index)`` : accumulate into upstream node's output ct
+      - ``None``                  : grad discarded (stop_gradient input)
+    """
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "input_routes",
+        "out_avals",
+        "out_tensors",
+        "post_hooks",
+        "multi",
+        "replay",
+    )
+
+    def __init__(self, name: str, vjp_fn: Callable, input_routes, out_avals, multi=False):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.input_routes = input_routes
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.out_tensors = None  # weakrefs set by dispatch for capture
+        self.post_hooks = []
+        self.multi = multi  # vjp expects a tuple cotangent
+        self.replay = None  # (diff_fn, input_tensors, multi) for create_graph
+
+
+# --------------------------------------------------------------------------
+# Engine
+# --------------------------------------------------------------------------
+def run_backward(
+    tensors: Sequence[Any],
+    grad_tensors: Optional[Sequence[Any]] = None,
+    retain_graph: bool = False,
+    capture: Optional[dict] = None,
+    accumulate_leaves: bool = True,
+    create_graph: bool = False,
+):
+    """Execute reverse pass from ``tensors`` (the roots).
+
+    ``capture`` maps ``id(tensor) -> tensor`` for paddle.grad-style queries;
+    returns ``{id: grad}`` for captured tensors (arrays, or Tensors when
+    ``create_graph``).
+
+    Mirrors BasicEngine::Execute (reference basic_engine.cc): init ready queue
+    from root nodes, dependency-count every reachable node, pop/run/route.
+    """
+    from .tensor import Tensor
+
+    roots = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+
+    captured: dict = {}
+    capture = capture or {}
+
+    if create_graph:
+        from .dispatch import eager_call
+
+        def _acc(dst, g):
+            if dst is None:
+                return g
+            return eager_call("grad_acc", jnp.add, [dst, g])
+
+        def _zeros(shape, dtype):
+            return Tensor(jnp.zeros(shape, dtype))
+
+        def _wrap(g, ref_t):
+            if isinstance(g, Tensor):
+                return g
+            return Tensor(jnp.asarray(g, dtype=ref_t._data.dtype))
+    else:
+
+        def _acc(dst, g):
+            a = g._data if isinstance(g, Tensor) else g
+            if dst is None:
+                return a
+            d = dst._data if isinstance(dst, Tensor) else dst
+            return jnp.add(d, a)
+
+        def _zeros(shape, dtype):
+            return jnp.zeros(shape, dtype)
+
+        def _wrap(g, ref_t):
+            if isinstance(g, Tensor):
+                return g._data
+            return jnp.asarray(g, dtype=ref_t._data.dtype)
+
+    # Seed cotangents. pending[node][out_idx] = accumulated cotangent.
+    pending: dict = {}
+    leaf_grads: dict = {}  # id(tensor) -> (tensor, grad)
+
+    def seed_leaf(t, g):
+        if accumulate_leaves and not t.stop_gradient:
+            key = id(t)
+            prev = leaf_grads.get(key, (t, None))[1]
+            leaf_grads[key] = (t, _acc(prev, g))
+        if id(t) in capture:
+            captured[id(t)] = _acc(captured.get(id(t)), g)
+
+    root_nodes = []
+    for t, g in zip(roots, grad_tensors):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar tensor requires grad_tensors"
+                )
+            g = _wrap(jnp.ones(t._data.shape, dtype=t._data.dtype), t)
+        else:
+            g = _wrap(g, t)
+        node = t._grad_node
+        if node is None:
+            seed_leaf(t, g)
+            continue
+        pmap = pending.setdefault(id(node), {})
+        idx = t._out_index
+        pmap[idx] = _acc(pmap.get(idx), g)
+        root_nodes.append(node)
+        # NB: no capture here — a node-produced root is captured exactly once
+        # when its producing node is processed (out_tensors scan), which sees
+        # this seed in the pending cotangents.
+
+    # Reachability + dependency counting (consumer edges per node).
+    deps: dict = {}
+    node_by_id: dict = {}
+    seen = set()
+    stack = list(root_nodes)
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        node_by_id[id(node)] = node
+        for route in node.input_routes:
+            if route is not None and route[0] == "node":
+                parent = route[1]
+                deps[id(parent)] = deps.get(id(parent), 0) + 1
+                stack.append(parent)
+
+    queue = [n for n in dict.fromkeys(id(n) for n in root_nodes) if deps.get(n, 0) == 0]
+    for n in root_nodes:
+        node_by_id[id(n)] = n
+    queue = [node_by_id[i] for i in queue]
+    processed = set()
+    while queue:
+        node = queue.pop()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        cts_map = pending.pop(id(node), {})
+        cts = tuple(
+            cts_map.get(i)
+            if cts_map.get(i) is not None
+            else _zeros(shape, dtype)
+            for i, (shape, dtype) in enumerate(node.out_avals)
+        )
+        # Capture cotangents of intermediate tensors produced by this node.
+        if node.out_tensors is not None:
+            for i, ref in enumerate(node.out_tensors):
+                t = ref() if callable(ref) else None
+                if t is not None and id(t) in capture:
+                    captured[id(t)] = _acc(captured.get(id(t)), cts[i])
+
+        if create_graph and node.replay is not None:
+            diff_fn, inputs_t, multi = node.replay
+            n_in = len(inputs_t)
+
+            def replay_fn(*all_args, n_in=n_in, multi=multi, diff_fn=diff_fn):
+                xs = all_args[:n_in]
+                cts_a = all_args[n_in:]
+                _, vjp_fn = jax.vjp(diff_fn, *xs)
+                return vjp_fn(tuple(cts_a) if multi else cts_a[0])
+
+            from .dispatch import eager_call
+
+            out = eager_call("grad_" + node.name, replay_fn, list(inputs_t) + list(cts))
+            in_grads = out if isinstance(out, (list, tuple)) else [out]
+        else:
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"Trying to backward through the graph a second time (node "
+                    f"'{node.name}' was already freed). Specify retain_graph=True "
+                    f"on the first backward call if you need to backward twice."
+                )
+            in_grads = node.vjp_fn(
+                tuple(c._data if hasattr(c, "_data") else c for c in cts)
+                if node.multi
+                else (cts[0]._data if hasattr(cts[0], "_data") else cts[0])
+            )
+            if not isinstance(in_grads, tuple):
+                in_grads = (in_grads,)
+        for hook in node.post_hooks:
+            hook()
+        if not retain_graph and not create_graph:
+            node.vjp_fn = None  # free residuals eagerly (reference GC parity)
+        for route, g in zip(node.input_routes, in_grads):
+            if route is None or g is None:
+                continue
+            kind = route[0]
+            if kind == "leaf":
+                seed_leaf(route[1], g)
+            else:
+                _, parent, idx = route
+                pmap = pending.setdefault(id(parent), {})
+                pmap[idx] = _acc(pmap.get(idx), g)
+                deps[id(parent)] -= 1
+                if deps[id(parent)] == 0:
+                    queue.append(parent)
+
+    for t, g in leaf_grads.values():
+        hook_g = g
+        for hook in t._backward_hooks:
+            out = hook(Tensor(hook_g) if not isinstance(hook_g, Tensor) else hook_g)
+            if out is not None:
+                hook_g = out._data if isinstance(out, Tensor) else out
+        g_arr = hook_g._data if isinstance(hook_g, Tensor) else hook_g
+        if t.grad is None:
+            t.grad = Tensor(g_arr, stop_gradient=True)
+        else:
+            t.grad._data = jnp.add(t.grad._data, g_arr)
+
+    return captured

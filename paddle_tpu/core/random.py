@@ -1,0 +1,71 @@
+"""Global RNG state.
+
+Analogue of the reference's generator (``paddle/fluid/framework/generator.cc``,
+``paddle.seed``). JAX PRNG is functional, so the "global generator" is a key
+that is split on every random op. When tracing a program (jit/to_static), the
+tracer installs a traced key provider so randomness becomes a program input
+rather than a baked-in constant — this is what makes dropout work under jit
+(cf. reference RNG-state control for parallel layers,
+``fleet/meta_parallel/parallel_layers/random.py:32``).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+        _state.provider = None
+    return _state
+
+
+def seed(s: int):
+    st = _get()
+    st.key = jax.random.PRNGKey(int(s))
+    return st.key
+
+
+def next_key():
+    """Return a fresh subkey. Inside a trace, defers to the installed provider."""
+    st = _get()
+    if getattr(st, "provider", None) is not None:
+        return st.provider()
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+class traced_keys:
+    """Install a traced key provider during program capture."""
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.count = 0
+
+    def __enter__(self):
+        st = _get()
+        self._prev = getattr(st, "provider", None)
+
+        def provider():
+            sub = jax.random.fold_in(self.base_key, self.count)
+            self.count += 1
+            return sub
+
+        st.provider = provider
+        return self
+
+    def __exit__(self, *exc):
+        _get().provider = self._prev
+        return False
+
+
+def get_rng_state():
+    return _get().key
+
+
+def set_rng_state(key):
+    _get().key = key

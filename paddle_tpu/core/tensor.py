@@ -1,0 +1,244 @@
+"""Eager Tensor.
+
+TPU-native analogue of the reference's dygraph ``VarBase``
+(``paddle/fluid/imperative/layer.h:66``): a named, autograd-tracked handle over
+a device buffer. Here the buffer is a ``jax.Array`` (PJRT-owned HBM), autograd
+metadata is a ``GradNode`` reference (cf. reference ``grad_node_info.h``), and
+methods are attached by the op library at import time — mirroring the
+reference's ``varbase_patch_methods.py`` monkey-patch design.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+from . import place as place_mod
+from .engine import run_backward, no_grad
+
+_tensor_count = 0
+
+
+def _next_name(prefix="eager_tmp"):
+    global _tensor_count
+    _tensor_count += 1
+    return f"{prefix}_{_tensor_count}"
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "name",
+        "persistable",
+        "_backward_hooks",
+        "__weakref__",
+    )
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        dt = dtypes.convert_dtype(dtype) if dtype is not None else None
+        if isinstance(data, jax.Array):
+            arr = data if dt is None else data.astype(dt)
+        else:
+            np_arr = np.asarray(data)
+            if dt is None and np_arr.dtype == np.float64:
+                dt = dtypes.get_default_dtype()  # paddle default-dtype semantics
+            arr = jnp.asarray(np_arr, dtype=dt)
+        if place is not None:
+            arr = jax.device_put(arr, place.jax_device())
+        self._data = arr
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = name or _next_name()
+        self.persistable = False
+        self._backward_hooks = []
+
+    # -- metadata ---------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        try:
+            dev = next(iter(self._data.devices()))
+            return place_mod.Place(dev.platform, dev.id)
+        except Exception:
+            return place_mod.current_place()
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    # -- host interop -----------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is ambiguous"
+            )
+        return bool(self.item())
+
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
+            f"place={self.place}, stop_gradient={self.stop_gradient},\n"
+            f"       {np.array2string(self.numpy(), prefix='       ')})"
+        )
+
+    # -- autograd ---------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        self._backward_hooks.append(hook)
+
+        class _Removable:
+            def remove(self_inner):
+                if hook in self._backward_hooks:
+                    self._backward_hooks.remove(hook)
+
+        return _Removable()
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    @property
+    def grad_fn(self):
+        return self._grad_node
+
+    # -- in-place / value management (optimizer fast path) ----------------
+    def _set_data(self, arr):
+        """Replace the underlying buffer (used by optimizers & loaders)."""
+        self._data = arr
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        arr = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(arr.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self._data.shape}"
+            )
+        self._data = arr
+
+    def copy_(self, other):
+        self.set_value(other)
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def cpu(self):
+        return Tensor(
+            jax.device_put(self._data, jax.devices("cpu")[0]),
+            stop_gradient=self.stop_gradient,
+        )
+
+    def to(self, *args, **kwargs):
+        dtype = None
+        place = None
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, place_mod.Place):
+                place = a
+            elif isinstance(a, str) and (":" in a or a in ("cpu", "tpu", "gpu")):
+                place = _parse_place(a)
+            else:
+                dtype = a
+        arr = self._data
+        if dtype is not None:
+            arr = arr.astype(dtypes.convert_dtype(dtype))
+        if place is not None:
+            arr = jax.device_put(arr, place.jax_device())
+        return Tensor(arr, stop_gradient=self.stop_gradient)
+
+    # NumPy-style protocol hooks so jnp.asarray(tensor) works.
+    def __jax_array__(self):
+        return self._data
+
+
+def _parse_place(device: str):
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    return place_mod.Place({"xla": "tpu", "cuda": "gpu"}.get(name, name), idx)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: ``framework.Parameter`` /
+    ``VarBase`` with persistable=True, stop_gradient=False)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(
+            data, dtype=dtype, stop_gradient=not trainable, name=name or _next_name("param")
+        )
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor equivalent."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,37 @@
+"""paddle.device parity (reference python/paddle/device/__init__.py)."""
+from ..core.place import (  # noqa: F401
+    set_device, get_device, current_place, Place, CPUPlace, TPUPlace, CUDAPlace,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+import jax
+
+
+def device_count(device_type=None):
+    devs = jax.devices()
+    if device_type:
+        devs = [d for d in devs if d.platform == device_type]
+    return len(devs)
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+class cuda:  # namespace parity: paddle.device.cuda
+    @staticmethod
+    def device_count():
+        return device_count("gpu")
+
+    @staticmethod
+    def synchronize(device=None):
+        pass
+
+
+def synchronize(device=None):
+    """Block until all pending device work completes (reference: device sync)."""
+    # JAX dispatch is async; a trivial transfer forces a sync point.
+    jax.effects_barrier()

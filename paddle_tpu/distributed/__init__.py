@@ -1,0 +1,47 @@
+"""paddle.distributed parity — TPU-native.
+
+Parity: reference ``python/paddle/distributed/`` (collective.py op wrappers,
+fleet, launch/spawn) over NCCL rings (§2.4 of SURVEY.md). TPU-native design:
+ONE global ``jax.sharding.Mesh`` over all chips; collectives are either
+ (a) eager host-visible ops executed via pmap-style shard_map on demand, or
+ (b) compiler-inserted HLO collectives when running inside pjit/shard_map —
+the idiomatic path. Process bootstrap maps to ``jax.distributed.initialize``
+(coordination service) instead of TCP ncclUniqueId plumbing
+(``paddle/fluid/platform/gen_comm_id_helper.cc:348``).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+from .collective import (  # noqa: F401
+    all_reduce, all_gather, all_to_all, alltoall, broadcast, reduce, scatter,
+    reduce_scatter, send, recv, barrier, split as _dist_split, new_group,
+    get_group, ReduceOp, wait,
+)
+from .parallel_env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv,
+)
+from . import fleet  # noqa: F401
+from .mesh import (  # noqa: F401
+    global_mesh, set_global_mesh, build_mesh, mesh_axis_size,
+)
+from .sharding_api import shard_tensor, shard_op  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from . import utils  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from .launch_mod import launch  # noqa: F401
+
+
+def get_backend():
+    return "xla"
+
+
+def is_initialized():
+    from .parallel_env import _initialized
+
+    return _initialized()

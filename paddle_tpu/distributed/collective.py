@@ -1,0 +1,360 @@
+"""Collective communication API.
+
+Parity: reference ``python/paddle/distributed/collective.py`` wrapping the
+C++ collective ops (``paddle/fluid/operators/collective/`` — c_allreduce_sum,
+c_allgather, alltoall, send_v2/recv_v2 …, SURVEY.md §2.4).
+
+TPU-native: a collective is an HLO op on a mesh axis. Called inside a
+``shard_map``/``pjit`` trace, these lower to ``lax.psum``/``all_gather``/
+``all_to_all``/``ppermute`` on ICI. Called eagerly on a single controller,
+they are the single-participant identity (world_size given by
+``jax.process_count()``) — matching the reference's 1-rank behavior. The
+reference's ring-id/comm-stream machinery has no equivalent because XLA's
+latency-hiding scheduler owns overlap.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..core.dispatch import as_tensor, eager_call
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communicator = a mesh axis (reference: NCCL ring / ProcessGroup)."""
+
+    _next_id = 0
+
+    def __init__(self, axis_name: Optional[str] = None, ranks=None, nranks=None):
+        Group._next_id += 1
+        self.id = Group._next_id
+        self.axis_name = axis_name
+        self.ranks = ranks or []
+        self._nranks = nranks
+
+    @property
+    def nranks(self):
+        if self._nranks is not None:
+            return self._nranks
+        if self.axis_name:
+            from .mesh import mesh_axis_size
+
+            return mesh_axis_size(self.axis_name)
+        return max(len(self.ranks), 1)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def process_group(self):
+        return self
+
+
+_default_group = None
+_groups = {}
+
+
+def new_group(ranks=None, backend=None, axis_name=None, timeout=None):
+    g = Group(axis_name=axis_name, ranks=ranks)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _get_default_group()
+    return _groups.get(gid)
+
+
+def _get_default_group():
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(axis_name=None, nranks=jax.process_count())
+    return _default_group
+
+
+def _is_traced(arr) -> bool:
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _axis(group):
+    if group is not None and group.axis_name:
+        return group.axis_name
+    return None
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
+    t = as_tensor(tensor)
+    axis = _axis(group)
+    if _is_traced(t._data) and axis is not None:
+        fns = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax, ReduceOp.MIN: lax.pmin}
+        if op == ReduceOp.AVG:
+            out = lax.pmean(t._data, axis)
+        elif op == ReduceOp.PROD:
+            out = jnp.exp(lax.psum(jnp.log(t._data), axis))
+        else:
+            out = fns[op](t._data, axis)
+        result = Tensor(out, stop_gradient=t.stop_gradient)
+        if isinstance(tensor, Tensor):
+            tensor._data = result._data
+        return result
+    # eager single-participant: identity
+    return t
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    t = as_tensor(tensor)
+    axis = _axis(group)
+    if _is_traced(t._data) and axis is not None:
+        gathered = lax.all_gather(t._data, axis)
+        n = gathered.shape[0]
+        if isinstance(tensor_list, list):
+            tensor_list.extend(Tensor(gathered[i]) for i in range(n))
+            return
+        return Tensor(gathered)
+    if isinstance(tensor_list, list):
+        tensor_list.append(t)
+        return
+    return t
+
+
+def all_gather_into_tensor(out, tensor, group=None, sync_op=True, concat_axis=0):
+    t = as_tensor(tensor)
+    axis = _axis(group)
+    if _is_traced(t._data) and axis is not None:
+        g = lax.all_gather(t._data, axis)
+        arr = jnp.concatenate([g[i] for i in range(g.shape[0])], axis=concat_axis)
+        return Tensor(arr)
+    return t
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    # On TPU a reduce-to-root is an all-reduce; root selection is free under SPMD.
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None, sync_op=True):
+    inp = as_tensor(tensor_list_or_input if not isinstance(tensor_list_or_input, list) else tensor_list_or_input[0])
+    axis = _axis(group)
+    if _is_traced(inp._data) and axis is not None:
+        out = lax.psum_scatter(inp._data, axis, scatter_dimension=0, tiled=True)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+        return Tensor(out)
+    return inp
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    t = as_tensor(tensor)
+    axis = _axis(group)
+    if _is_traced(t._data) and axis is not None:
+        idx = lax.axis_index(axis)
+        src_val = lax.all_gather(t._data, axis)[src]
+        if isinstance(tensor, Tensor):
+            tensor._data = src_val
+        return Tensor(src_val)
+    return t
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    t = as_tensor(tensor)
+    axis = _axis(group)
+    if _is_traced(t._data) and axis is not None and tensor_list is not None:
+        stacked = jnp.stack([as_tensor(x)._data for x in tensor_list])
+        idx = lax.axis_index(axis)
+        out = stacked[idx]
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+        return Tensor(out)
+    return t
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Reference: alltoall op (MoE global routing building block)."""
+    axis = _axis(group)
+    if isinstance(in_tensor_list, list):
+        x = jnp.stack([as_tensor(t)._data for t in in_tensor_list])
+    else:
+        x = as_tensor(in_tensor_list)._data
+    if _is_traced(x) and axis is not None:
+        out = lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.extend(Tensor(out[i]) for i in range(out.shape[0]))
+            return
+        return Tensor(out)
+    if isinstance(out_tensor_list, list):
+        out_tensor_list.extend(Tensor(x[i]) for i in range(x.shape[0]))
+        return
+    return Tensor(x)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    out_tensor_list = [] if out_tensor_list is None else out_tensor_list
+    all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
+    return out_tensor_list
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    t = as_tensor(in_tensor)
+    axis = _axis(group)
+    if _is_traced(t._data) and axis is not None:
+        out = lax.all_to_all(t._data, axis, split_axis=0, concat_axis=0, tiled=True)
+        if isinstance(out_tensor, Tensor):
+            out_tensor._data = out
+        return Tensor(out)
+    return t
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """p2p send — inside shard_map lower to ppermute (see parallel/pp_utils)."""
+    return as_tensor(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return as_tensor(tensor)
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+def barrier(group=None):
+    jax.effects_barrier()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and not _is_traced(tensor._data):
+        tensor._data.block_until_ready()
+
+
+def split(x, num_partitions, axis=0, group=None):
+    from ..ops.manipulation import split as _split
+
+    return _split(x, num_partitions, axis)
+
+
+# -- mp helper prims (reference collective.py:790,876,924,1032) --------------
+def _c_identity(tensor, group=None):
+    """Forward identity; backward all-reduce (column-parallel input)."""
+    t = as_tensor(tensor)
+    axis = _axis(group)
+    if not (_is_traced(t._data) and axis is not None):
+        return t
+
+    @jax.custom_vjp
+    def ident(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, ct):
+        return (lax.psum(ct, axis),)
+
+    ident.defvjp(fwd, bwd)
+    return eager_call("c_identity", ident, [t])
+
+
+def _mp_allreduce(tensor, group=None):
+    """Forward all-reduce; backward identity (row-parallel output)."""
+    t = as_tensor(tensor)
+    axis = _axis(group)
+    if not (_is_traced(t._data) and axis is not None):
+        return t
+
+    @jax.custom_vjp
+    def ar(x):
+        return lax.psum(x, axis)
+
+    def fwd(x):
+        return lax.psum(x, axis), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    ar.defvjp(fwd, bwd)
+    return eager_call("mp_allreduce", ar, [t])
+
+
+def _c_split(tensor, group=None):
+    """Split along last dim, keep this rank's shard (fwd); all-gather (bwd)."""
+    t = as_tensor(tensor)
+    axis = _axis(group)
+    if not (_is_traced(t._data) and axis is not None):
+        return t
+    n = group.nranks
+
+    def fn(x):
+        idx = lax.axis_index(axis)
+        size = x.shape[-1] // n
+        return lax.dynamic_slice_in_dim(x, idx * size, size, axis=x.ndim - 1)
+
+    return eager_call("c_split", fn, [t])
+
+
+def _c_concat(tensor, group=None):
+    """All-gather along last dim (column-parallel output gather)."""
+    t = as_tensor(tensor)
+    axis = _axis(group)
+    if not (_is_traced(t._data) and axis is not None):
+        return t
+
+    def fn(x):
+        return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+
+    return eager_call("c_concat", fn, [t])
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None, ignore_index=-100):
+    """Vocab-sharded softmax CE (reference collective.py:1032 +
+    c_softmax_with_cross_entropy_op.cu): logits sharded on the class dim
+    across the mp axis; per-rank partial max/sum are all-reduced."""
+    lg, lb = as_tensor(logits), as_tensor(label)
+    axis = _axis(group)
+    if not (_is_traced(lg._data) and axis is not None):
+        from ..nn.functional.loss import cross_entropy
+
+        return cross_entropy(lg, lb, reduction="none", ignore_index=ignore_index)
+    n = group.nranks
+
+    def fn(x, lab):
+        # x: (..., V/n) local shard of logits
+        local_max = jnp.max(x, axis=-1, keepdims=True)
+        gmax = lax.pmax(local_max, axis)
+        ex = jnp.exp(x - gmax)
+        local_sum = jnp.sum(ex, axis=-1, keepdims=True)
+        gsum = lax.psum(local_sum, axis)
+        logp = x - gmax - jnp.log(gsum)
+        vshard = x.shape[-1]
+        ridx = lax.axis_index(axis)
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == x.ndim:
+            lab_i = jnp.squeeze(lab_i, -1)
+        local_lab = lab_i - ridx * vshard
+        in_range = (local_lab >= 0) & (local_lab < vshard)
+        safe = jnp.clip(local_lab, 0, vshard - 1)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        loss_local = jnp.where(in_range, -picked, 0.0)
+        return lax.psum(loss_local, axis)
+
+    return eager_call("c_softmax_with_cross_entropy", fn, [lg, lb])

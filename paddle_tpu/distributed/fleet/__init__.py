@@ -1,0 +1,19 @@
+"""paddle.distributed.fleet parity — TPU-native.
+
+Reference: ``python/paddle/distributed/fleet/`` — fleet.init /
+distributed_model / distributed_optimizer, DistributedStrategy, hybrid
+topology. Here the hybrid topology materializes ONE jax.sharding.Mesh with
+named axes and the "meta-optimizers"/"meta-parallel" wrappers become sharding
+rules + shard_map programs compiled by XLA.
+"""
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .base.fleet_base import (  # noqa: F401
+    init, is_first_worker, worker_index, worker_num, is_worker,
+    distributed_model, distributed_optimizer, get_hybrid_communicate_group,
+    _get_strategy,
+)
+from .base.role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401
+from .. import collective as _collective
+from . import meta_parallel  # noqa: F401
+from .utils import recompute  # noqa: F401

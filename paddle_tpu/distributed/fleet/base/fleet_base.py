@@ -1,0 +1,98 @@
+"""Fleet entry points.
+
+Parity: reference ``fleet/base/fleet_base.py`` — ``init:170`` builds the
+HybridCommunicateGroup from strategy.hybrid_configs;
+``distributed_model:896`` dispatches to Sharding/Data/Tensor/Pipeline
+wrappers (``:954-992``); ``distributed_optimizer:839`` wraps the optimizer.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .distributed_strategy import DistributedStrategy
+from .role_maker import PaddleCloudRoleMaker
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+_strategy: Optional[DistributedStrategy] = None
+_hcg: Optional[HybridCommunicateGroup] = None
+_role_maker = None
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    global _strategy, _hcg, _role_maker
+    _strategy = strategy or DistributedStrategy()
+    _role_maker = role_maker or PaddleCloudRoleMaker(is_collective=is_collective)
+
+    hc = _strategy.hybrid_configs
+    topo = CommunicateTopology(
+        hybrid_group_names=["pipe", "data", "sharding", "sequence", "model"],
+        dims=[
+            hc.get("pp_degree", 1),
+            hc.get("dp_degree", 1),
+            hc.get("sharding_degree", 1),
+            hc.get("sp_degree", 1),
+            hc.get("mp_degree", 1),
+        ],
+    )
+    _hcg = HybridCommunicateGroup(topo)
+    return None
+
+
+def _get_strategy() -> DistributedStrategy:
+    return _strategy or DistributedStrategy()
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+fleet = None  # populated lazily for reference-style `fleet.fleet` access
+
+
+def is_first_worker():
+    return _role_maker.is_first_worker() if _role_maker else jax.process_index() == 0
+
+
+def worker_index():
+    return _role_maker.worker_index() if _role_maker else jax.process_index()
+
+
+def worker_num():
+    return _role_maker.worker_num() if _role_maker else jax.process_count()
+
+
+def is_worker():
+    return True
+
+
+def distributed_model(model):
+    """Wrap for the active parallelism mix (reference fleet_base.py:954-992)."""
+    strategy = _get_strategy()
+    hcg = _hcg
+    if hcg is None:
+        return model
+    from ..meta_parallel.parallel_wrappers import (
+        PipelineParallel, ShardingParallel, TensorParallel,
+    )
+    from ...parallel import DataParallel
+
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from ..meta_parallel.pipeline_parallel import PipelineParallelModel
+
+        return PipelineParallelModel(model, hcg, strategy)
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return ShardingParallel(model, hcg, strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, strategy)
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Reference fleet_base.py:839 → HybridParallelOptimizer."""
+    from ..meta_optimizers.hybrid_parallel_optimizer import HybridParallelOptimizer
+
+    return HybridParallelOptimizer(optimizer, _hcg, strategy or _get_strategy())

@@ -1,0 +1,41 @@
+"""Role makers (reference fleet/base/role_maker.py:519 PaddleCloudRoleMaker —
+reads PADDLE_* env to determine rank/endpoints)."""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class RoleMakerBase:
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def worker_num(self):
+        return jax.process_count()
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+    def worker_num(self):
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective)
+        self._kwargs = kwargs

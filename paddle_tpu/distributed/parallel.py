@@ -1,0 +1,59 @@
+"""DataParallel wrapper.
+
+Parity: reference ``paddle.DataParallel``
+(``python/paddle/fluid/dygraph/parallel.py:397``) + C++ Reducer bucketing
+(``paddle/fluid/imperative/reducer.cc``). TPU-native: gradient averaging is
+compiler-inserted when the train step runs under pjit with the batch sharded
+on the dp axis — no bucket/fusion machinery is needed (XLA fuses and
+schedules the all-reduces). Inside a shard_map trace, backward hooks psum
+grads over the dp axis to give the same semantics op-for-op.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.add_sublayer("_layers", layers)
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layers(*inputs, **kwargs)
+        return out
+
+    def _psum_grads_hook(self):
+        """Register per-param grad psum for explicit shard_map DP training."""
+        axis = self._group.axis_name if self._group is not None else "dp"
+
+        def make_hook():
+            def hook(grad_arr):
+                if isinstance(grad_arr, jax.core.Tracer):
+                    from .mesh import mesh_axis_size
+
+                    return Tensor(lax.pmean(grad_arr._data if isinstance(grad_arr, Tensor) else grad_arr, axis))
+                return grad_arr
+
+            return hook
+
+        for p in self._layers.parameters():
+            p.register_hook(make_hook())
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

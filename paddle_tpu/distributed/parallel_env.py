@@ -1,0 +1,85 @@
+"""Parallel environment bootstrap.
+
+Parity: reference ``python/paddle/distributed/parallel.py``
+(init_parallel_env: NCCL id TCP bootstrap + ParallelEnv from PADDLE_* env).
+TPU-native: ``jax.distributed.initialize`` (coordination service) replaces
+comm-id plumbing; rank/world come from the PJRT process topology.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_init_done = False
+
+
+def _initialized():
+    return _init_done
+
+
+def init_parallel_env():
+    global _init_done
+    if _init_done:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_TPU_COORDINATOR") or os.environ.get("COORDINATOR_ADDRESS")
+    if coord and jax.process_count() == 1:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            )
+        except Exception:
+            pass
+    _init_done = True
+    return ParallelEnv()
+
+
+def get_rank(group=None):
+    if group is not None:
+        return 0
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+class ParallelEnv:
+    """Reference: fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", get_rank()))
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def device_type(self):
+        return jax.default_backend()
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170")
+        return eps.split(",")

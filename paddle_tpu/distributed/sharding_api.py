@@ -1,0 +1,57 @@
+"""Sharding annotation API (auto-parallel style).
+
+Parity+: reference auto-parallel ``shard_tensor``
+(``python/paddle/distributed/auto_parallel/interface.py``) — here it IS the
+GSPMD annotation: attach a NamedSharding / apply with_sharding_constraint.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from .mesh import global_mesh
+
+
+def shard_tensor(x, mesh=None, placement=None, dist_attr=None):
+    """Place/annotate a tensor on the mesh. ``placement`` is a PartitionSpec
+    or a list of axis names (None = replicated dim)."""
+    mesh = mesh or global_mesh()
+    if placement is None:
+        spec = PartitionSpec()
+    elif isinstance(placement, PartitionSpec):
+        spec = placement
+    else:
+        spec = PartitionSpec(*placement)
+    sharding = NamedSharding(mesh, spec)
+    if isinstance(x, Tensor):
+        if isinstance(x._data, jax.core.Tracer):
+            x._data = jax.lax.with_sharding_constraint(x._data, sharding)
+            return x
+        x._data = jax.device_put(x._data, sharding)
+        return x
+    return jax.device_put(x, sharding)
+
+
+def shard_op(op, mesh=None, in_specs=None, out_specs=None):
+    """Wrap a callable so inputs/outputs carry sharding constraints."""
+    mesh = mesh or global_mesh()
+
+    def wrapped(*args, **kwargs):
+        if in_specs is not None:
+            args = tuple(
+                shard_tensor(a, mesh, s) if s is not None else a
+                for a, s in zip(args, in_specs)
+            )
+        out = op(*args, **kwargs)
+        if out_specs is not None:
+            if isinstance(out, (list, tuple)):
+                out = type(out)(
+                    shard_tensor(o, mesh, s) if s is not None else o
+                    for o, s in zip(out, out_specs)
+                )
+            else:
+                out = shard_tensor(out, mesh, out_specs)
+        return out
+
+    return wrapped

@@ -1,0 +1,13 @@
+"""paddle.distributed.spawn parity.
+
+Reference: ``python/paddle/distributed/spawn.py`` — fork N single-GPU
+processes. TPU-native single-controller runtime: one process drives all
+chips, so spawn() runs the function once with the full mesh; multihost
+launches go through paddle_tpu.distributed.launch (one process per host).
+"""
+from __future__ import annotations
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    func(*args)
+    return None

@@ -1,0 +1,44 @@
+"""Expert-parallel routing prims.
+
+Parity: reference ``python/paddle/distributed/utils.py:57,179``
+global_scatter/global_gather backed by C++ all-to-all-v ops
+(``operators/collective/global_scatter_op.cc``). TPU-native: fixed-capacity
+all_to_all (static shapes; tokens bucketed per expert with capacity factor) —
+the standard TPU MoE formulation.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..core.dispatch import as_tensor, eager_call
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Send token rows to expert owners across the ep axis (all-to-all)."""
+    t = as_tensor(x)
+    axis = group.axis_name if group is not None else None
+    if isinstance(t._data, jax.core.Tracer) and axis is not None:
+        def fn(a):
+            return lax.all_to_all(a, axis, split_axis=0, concat_axis=0, tiled=True)
+
+        return eager_call("global_scatter", fn, [t])
+    return t
+
+
+def global_gather(x, local_count, global_count, group=None):
+    t = as_tensor(x)
+    axis = group.axis_name if group is not None else None
+    if isinstance(t._data, jax.core.Tracer) and axis is not None:
+        def fn(a):
+            return lax.all_to_all(a, axis, split_axis=0, concat_axis=0, tiled=True)
+
+        return eager_call("global_gather", fn, [t])
+    return t
+
+
+def get_cluster_from_args(args, selected_gpus=None):
+    raise NotImplementedError("single-controller runtime: use paddle_tpu.distributed.launch")

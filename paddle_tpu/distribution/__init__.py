@@ -1,0 +1,134 @@
+"""paddle.distribution parity (reference python/paddle/distribution/)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as random_state
+from ..core.tensor import Tensor
+from ..core.dispatch import as_tensor, eager_call
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        from ..ops.math import exp
+
+        return exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = as_tensor(low)
+        self.high = as_tensor(high)
+
+    def sample(self, shape=(), seed=0):
+        key = random_state.next_key()
+        shape = tuple(shape) + tuple(np.broadcast_shapes(tuple(self.low.shape), tuple(self.high.shape)))
+        u = jax.random.uniform(key, shape, dtype=jnp.float32)
+        return Tensor(self.low._data + u * (self.high._data - self.low._data))
+
+    def log_prob(self, value):
+        return eager_call(
+            "uniform_log_prob",
+            lambda v, lo, hi: jnp.where(
+                (v >= lo) & (v < hi), -jnp.log(hi - lo), -jnp.inf
+            ),
+            [as_tensor(value), self.low, self.high],
+        )
+
+    def entropy(self):
+        return eager_call("uniform_entropy", lambda lo, hi: jnp.log(hi - lo), [self.low, self.high])
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = as_tensor(loc)
+        self.scale = as_tensor(scale)
+
+    def sample(self, shape=(), seed=0):
+        key = random_state.next_key()
+        shape = tuple(shape) + tuple(np.broadcast_shapes(tuple(self.loc.shape), tuple(self.scale.shape)))
+        z = jax.random.normal(key, shape, dtype=jnp.float32)
+        return Tensor(self.loc._data + z * self.scale._data)
+
+    def log_prob(self, value):
+        return eager_call(
+            "normal_log_prob",
+            lambda v, m, s: -((v - m) ** 2) / (2 * s**2) - jnp.log(s) - 0.5 * math.log(2 * math.pi),
+            [as_tensor(value), self.loc, self.scale],
+        )
+
+    def entropy(self):
+        return eager_call(
+            "normal_entropy", lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), [self.scale]
+        )
+
+    def kl_divergence(self, other):
+        return eager_call(
+            "normal_kl",
+            lambda m1, s1, m2, s2: jnp.log(s2 / s1) + (s1**2 + (m1 - m2) ** 2) / (2 * s2**2) - 0.5,
+            [self.loc, self.scale, other.loc, other.scale],
+        )
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = as_tensor(logits)
+
+    def sample(self, shape=()):
+        key = random_state.next_key()
+        out = jax.random.categorical(key, self.logits._data, shape=tuple(shape) + tuple(self.logits.shape[:-1]))
+        return Tensor(out.astype(np.int64))
+
+    def log_prob(self, value):
+        return eager_call(
+            "cat_log_prob",
+            lambda lg, v: jnp.take_along_axis(
+                jax.nn.log_softmax(lg, axis=-1), v.astype(jnp.int32)[..., None], axis=-1
+            )[..., 0],
+            [self.logits, as_tensor(value)],
+        )
+
+    def entropy(self):
+        return eager_call(
+            "cat_entropy",
+            lambda lg: -jnp.sum(jax.nn.softmax(lg, -1) * jax.nn.log_softmax(lg, -1), axis=-1),
+            [self.logits],
+        )
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_t = as_tensor(probs)
+
+    def sample(self, shape=()):
+        key = random_state.next_key()
+        return Tensor(
+            jax.random.bernoulli(key, self.probs_t._data, tuple(shape) + tuple(self.probs_t.shape)).astype(np.float32)
+        )
+
+    def log_prob(self, value):
+        return eager_call(
+            "bern_log_prob",
+            lambda p, v: v * jnp.log(jnp.clip(p, 1e-12)) + (1 - v) * jnp.log(jnp.clip(1 - p, 1e-12)),
+            [self.probs_t, as_tensor(value)],
+        )
+
+
+def kl_divergence(p, q):
+    return p.kl_divergence(q)

@@ -1,0 +1,8 @@
+"""Framework internals: persistence, flags, program-level helpers."""
+from .io import save, load  # noqa: F401
+from .flags import set_flags, get_flags  # noqa: F401
+from ..core.tensor import Parameter  # noqa: F401
+
+
+def in_dygraph_mode():
+    return True

@@ -1,0 +1,51 @@
+"""Global flag registry.
+
+Reference: gflags exported via ``paddle/fluid/platform/flags.cc`` (53 flags) +
+``pybind/global_value_getter_setter.cc`` → ``paddle.set_flags/get_flags`` and
+``FLAGS_*`` env pickup. Here flags mostly steer debug behavior (nan/inf
+checking, deterministic ops) and XLA options.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+_FLAGS: Dict[str, object] = {
+    "FLAGS_check_nan_inf": False,          # reference operator.cc:1171 nan/inf scan
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_use_bf16_matmul": True,         # TPU-native: allow bf16 matmul precision
+    "FLAGS_jit_cache_size": 4096,
+    "FLAGS_log_level": 0,
+}
+
+# Env pickup at import (reference: gflags env integration)
+for _k in list(_FLAGS):
+    if _k in os.environ:
+        v = os.environ[_k]
+        cur = _FLAGS[_k]
+        if isinstance(cur, bool):
+            _FLAGS[_k] = v.lower() in ("1", "true", "yes")
+        elif isinstance(cur, float):
+            _FLAGS[_k] = float(v)
+        elif isinstance(cur, int):
+            _FLAGS[_k] = int(v)
+        else:
+            _FLAGS[_k] = v
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        _FLAGS[k] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS.get(k) for k in flags}
+
+
+def flag(name, default=None):
+    return _FLAGS.get(name, default)

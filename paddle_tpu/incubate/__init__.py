@@ -1,0 +1,27 @@
+"""paddle.incubate parity (reference python/paddle/incubate/) — fused layers."""
+from . import nn  # noqa: F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    from ..core.dispatch import as_tensor, eager_call
+    import jax
+    import jax.numpy as jnp
+
+    return eager_call(
+        "softmax_mask_fuse",
+        lambda a, m: jax.nn.softmax(a + m, axis=-1),
+        [as_tensor(x), as_tensor(mask)],
+    )
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    from ..core.dispatch import as_tensor, eager_call
+    import jax
+    import jax.numpy as jnp
+
+    def fn(a):
+        T = a.shape[-1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+
+    return eager_call("softmax_mask_fuse_upper_triangle", fn, [as_tensor(x)])

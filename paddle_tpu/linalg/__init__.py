@@ -1,0 +1,8 @@
+"""paddle.linalg namespace (reference python/paddle/linalg.py re-exports)."""
+from ..ops.linalg import (  # noqa: F401
+    cholesky, inv, inverse, det, slogdet, svd, qr, eig, eigh, eigvals,
+    eigvalsh, norm, cond, matrix_power, matrix_rank, pinv, solve,
+    triangular_solve, cholesky_solve, lstsq, lu, multi_dot, corrcoef, cov,
+    householder_product,
+)
+from ..ops.math import matmul, dot  # noqa: F401

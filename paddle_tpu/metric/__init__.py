@@ -1,0 +1,160 @@
+"""paddle.metric parity (reference python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import as_tensor
+
+
+class Metric:
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        pred = np.asarray(as_tensor(pred)._data)
+        label = np.asarray(as_tensor(label)._data)
+        if label.ndim == 1:
+            label = label.reshape(-1, 1)
+        maxk = max(self.topk)
+        idx = np.argsort(-pred, axis=-1)[..., :maxk]
+        correct = idx == label
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        correct = np.asarray(as_tensor(correct)._data)
+        accs = []
+        for i, k in enumerate(self.topk):
+            num = correct[..., :k].sum()
+            self.total[i] += float(num)
+            self.count[i] += int(correct.shape[0])
+            accs.append(float(num) / max(correct.shape[0], 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return [f"{self._name}_top{k}" for k in self.topk] if len(self.topk) > 1 else [self._name]
+
+
+class Precision(Metric):
+    def __init__(self, name=None, *args, **kwargs):
+        super().__init__()
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(as_tensor(preds)._data).round().astype(np.int32).reshape(-1)
+        labels = np.asarray(as_tensor(labels)._data).astype(np.int32).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        return self.tp / max(self.tp + self.fp, 1)
+
+    def name(self):
+        return [self._name]
+
+
+class Recall(Metric):
+    def __init__(self, name=None, *args, **kwargs):
+        super().__init__()
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(as_tensor(preds)._data).round().astype(np.int32).reshape(-1)
+        labels = np.asarray(as_tensor(labels)._data).astype(np.int32).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        return self.tp / max(self.tp + self.fn, 1)
+
+    def name(self):
+        return [self._name]
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None, *args, **kwargs):
+        super().__init__()
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(as_tensor(preds)._data)
+        labels = np.asarray(as_tensor(labels)._data).reshape(-1)
+        if preds.ndim == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        bins = np.minimum((preds * self.num_thresholds).astype(np.int64), self.num_thresholds)
+        for b, l in zip(bins, labels):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid over thresholds, descending
+        pos_cum = np.cumsum(self._stat_pos[::-1])
+        neg_cum = np.cumsum(self._stat_neg[::-1])
+        tpr = pos_cum / tot_pos
+        fpr = neg_cum / tot_neg
+        return float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return [self._name]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    pred = np.asarray(as_tensor(input)._data)
+    lab = np.asarray(as_tensor(label)._data).reshape(-1)
+    idx = np.argsort(-pred, axis=-1)[..., :k]
+    hit = (idx == lab[:, None]).any(axis=-1)
+    return Tensor(np.asarray(hit.mean(), dtype=np.float32))

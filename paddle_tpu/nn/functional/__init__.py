@@ -1,0 +1,35 @@
+"""paddle.nn.functional parity namespace."""
+from .activation import (  # noqa: F401
+    relu, relu6, sigmoid, tanh, silu, swish, mish, tanhshrink, softsign,
+    log_sigmoid, gelu, leaky_relu, prelu, elu, celu, selu, hardshrink,
+    softshrink, hardtanh, hardsigmoid, hardswish, softplus, softmax,
+    log_softmax, gumbel_softmax, maxout, glu, thresholded_relu, rrelu,
+)
+from .common import (  # noqa: F401
+    linear, dropout, dropout2d, dropout3d, alpha_dropout, pad, one_hot,
+    embedding, label_smooth, interpolate, upsample, unfold, fold,
+    cosine_similarity, pixel_shuffle, pixel_unshuffle, channel_shuffle,
+    normalize, bilinear,
+)
+from .conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+    conv3d_transpose,
+)
+from .pooling import (  # noqa: F401
+    max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
+    adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
+    adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
+)
+from .norm import (  # noqa: F401
+    batch_norm, layer_norm, group_norm, instance_norm, local_response_norm,
+    spectral_norm,
+)
+from .loss import (  # noqa: F401
+    cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
+    smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
+    kl_div, margin_ranking_loss, hinge_embedding_loss, cosine_embedding_loss,
+    triplet_margin_loss, log_loss, square_error_cost, ctc_loss,
+    sigmoid_focal_loss,
+)
+from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
+from .sparse_attention import sparse_attention  # noqa: F401

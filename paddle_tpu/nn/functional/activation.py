@@ -1,0 +1,213 @@
+"""Activation functionals.
+
+Parity: reference ``python/paddle/nn/functional/activation.py`` backed by
+``paddle/fluid/operators/activation_op.*`` kernels — here jax.nn/XLA, fused
+into surrounding matmuls by the compiler.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import as_tensor, eager_call
+
+
+def _act(op_name, jfn):
+    def op(x, name=None):
+        return eager_call(op_name, jfn, [as_tensor(x)])
+
+    op.__name__ = op_name
+    return op
+
+
+relu = _act("relu", jax.nn.relu)
+relu6 = _act("relu6", jax.nn.relu6)
+sigmoid = _act("sigmoid", jax.nn.sigmoid)
+tanh = _act("tanh", jnp.tanh)
+silu = _act("silu", jax.nn.silu)
+swish = silu
+mish = _act("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+tanhshrink = _act("tanhshrink", lambda a: a - jnp.tanh(a))
+softsign = _act("softsign", jax.nn.soft_sign)
+log_sigmoid = _act("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    return eager_call(
+        "gelu", lambda a, approximate: jax.nn.gelu(a, approximate=approximate),
+        [as_tensor(x)], {"approximate": approximate},
+    )
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return eager_call(
+        "leaky_relu",
+        lambda a, negative_slope: jax.nn.leaky_relu(a, negative_slope),
+        [as_tensor(x)],
+        {"negative_slope": negative_slope},
+    )
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+
+    def fn(a, w, data_format):
+        if w.size > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, w * a)
+
+    return eager_call("prelu", fn, [x, weight], {"data_format": data_format})
+
+
+def elu(x, alpha=1.0, name=None):
+    return eager_call("elu", lambda a, alpha: jax.nn.elu(a, alpha), [as_tensor(x)], {"alpha": alpha})
+
+
+def celu(x, alpha=1.0, name=None):
+    return eager_call("celu", lambda a, alpha: jax.nn.celu(a, alpha), [as_tensor(x)], {"alpha": alpha})
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return eager_call(
+        "selu",
+        lambda a, scale, alpha: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+        [as_tensor(x)],
+        {"scale": scale, "alpha": alpha},
+    )
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return eager_call(
+        "hardshrink",
+        lambda a, threshold: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype),
+        [as_tensor(x)],
+        {"threshold": threshold},
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return eager_call(
+        "softshrink",
+        lambda a, t: jnp.where(a > t, a - t, jnp.where(a < -t, a + t, 0.0)).astype(a.dtype),
+        [as_tensor(x)],
+        {"t": threshold},
+    )
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return eager_call(
+        "hardtanh", lambda a, mn, mx: jnp.clip(a, mn, mx), [as_tensor(x)], {"mn": min, "mx": max}
+    )
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return eager_call(
+        "hardsigmoid",
+        lambda a, slope, offset: jnp.clip(slope * a + offset, 0.0, 1.0),
+        [as_tensor(x)],
+        {"slope": slope, "offset": offset},
+    )
+
+
+def hardswish(x, name=None):
+    return eager_call("hardswish", lambda a: a * jnp.clip(a / 6.0 + 0.5, 0.0, 1.0), [as_tensor(x)])
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return eager_call(
+        "softplus",
+        lambda a, beta, threshold: jnp.where(
+            beta * a > threshold, a, jax.nn.softplus(beta * a) / beta
+        ),
+        [as_tensor(x)],
+        {"beta": beta, "threshold": threshold},
+    )
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from ...ops.math import cast
+
+        x = cast(x, dtype)
+    return eager_call("softmax", lambda a, axis: jax.nn.softmax(a, axis=axis), [x], {"axis": int(axis)})
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from ...ops.math import cast
+
+        x = cast(x, dtype)
+    return eager_call(
+        "log_softmax", lambda a, axis: jax.nn.log_softmax(a, axis=axis), [x], {"axis": int(axis)}
+    )
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as random_state
+    from ...core.tensor import Tensor
+
+    x = as_tensor(x)
+    key = random_state.next_key()
+    g = jax.random.gumbel(key, x._data.shape, dtype=x._data.dtype)
+    gt = Tensor(g)
+
+    def fn(a, gumbel, temperature, hard, axis):
+        y = jax.nn.softmax((a + gumbel) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            hard_y = jnp.zeros_like(y)
+            hard_y = jnp.put_along_axis(hard_y, idx, 1.0, axis=axis, inplace=False)
+            y = jax.lax.stop_gradient(hard_y - y) + y
+        return y
+
+    return eager_call(
+        "gumbel_softmax", fn, [x, gt], {"temperature": temperature, "hard": hard, "axis": axis}
+    )
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a, groups, axis):
+        shape = list(a.shape)
+        c = shape[axis]
+        shape[axis : axis + 1] = [c // groups, groups]
+        return jnp.max(a.reshape(shape), axis=axis + 1)
+
+    return eager_call("maxout", fn, [as_tensor(x)], {"groups": groups, "axis": axis})
+
+
+def glu(x, axis=-1, name=None):
+    return eager_call("glu", lambda a, axis: jax.nn.glu(a, axis=axis), [as_tensor(x)], {"axis": axis})
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return eager_call(
+        "thresholded_relu",
+        lambda a, threshold: jnp.where(a > threshold, a, 0.0).astype(a.dtype),
+        [as_tensor(x)],
+        {"threshold": threshold},
+    )
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    from ...core import random as random_state
+    from ...core.tensor import Tensor
+
+    x = as_tensor(x)
+    if training:
+        key = random_state.next_key()
+        slope = jax.random.uniform(key, x._data.shape, minval=lower, maxval=upper, dtype=jnp.float32).astype(x._data.dtype)
+    else:
+        slope = jnp.asarray((lower + upper) / 2.0, dtype=x._data.dtype)
+        slope = jnp.broadcast_to(slope, x._data.shape)
+    st = Tensor(slope)
+    return eager_call("rrelu", lambda a, s: jnp.where(a >= 0, a, s * a), [x, st])

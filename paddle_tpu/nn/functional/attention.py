@@ -1,0 +1,63 @@
+"""Attention functionals.
+
+Parity+: the reference only has fused_attention (C++
+``paddle/fluid/operators/fused/fused_attention_op.cc`` / ``fmha_ref.h``); we
+provide the same capability as a functional that XLA fuses, plus a
+flash-attention entry point that routes to the Pallas TPU kernel when
+available (paddle_tpu/ops/pallas/flash_attention.py).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import as_tensor, eager_call
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+):
+    """q,k,v: (B, T, H, D) — paddle convention. Returns (B, T, H, D)."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    inputs = [q, k, v]
+    has_mask = attn_mask is not None
+    if has_mask:
+        inputs.append(as_tensor(attn_mask))
+
+    def fn(q, k, v, *m, is_causal=False, has_mask=False):
+        # (B, T, H, D) → (B, H, T, D)
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        scale = 1.0 / math.sqrt(qh.shape[-1])
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if has_mask:
+            scores = scores + m[0]
+        if is_causal:
+            tq, tk = scores.shape[-2], scores.shape[-1]
+            causal = jnp.tril(jnp.ones((tq, tk), bool))
+            scores = jnp.where(causal, scores, jnp.asarray(-1e30, scores.dtype))
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+        return jnp.swapaxes(out, 1, 2)
+
+    return eager_call(
+        "scaled_dot_product_attention", fn, inputs,
+        {"is_causal": is_causal, "has_mask": has_mask},
+    )
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, name=None):
+    """Flash attention — Pallas TPU kernel when on TPU, XLA fallback otherwise."""
+    q = as_tensor(query)
+    try:
+        from ...ops.pallas.flash_attention import flash_attention_tpu
+
+        out = flash_attention_tpu(q, as_tensor(key), as_tensor(value), causal=causal)
+    except Exception:
+        out = scaled_dot_product_attention(query, key, value, is_causal=causal)
+    if return_softmax:
+        return out, None
+    return out, None

@@ -1,0 +1,335 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, etc.
+
+Parity: reference ``python/paddle/nn/functional/common.py`` (linear at
+:1472 → matmul_v2 + elementwise_add), ``input.py`` (one_hot/embedding →
+lookup_table_v2), dropout kernels (``paddle/fluid/operators/dropout_op.*``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import random as random_state
+from ...core.tensor import Tensor
+from ...core.dispatch import as_tensor, eager_call
+from ...ops.manipulation import pad as _pad_op
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Reference functional/common.py:1472 — one MXU matmul."""
+    x, weight = as_tensor(x), as_tensor(weight)
+    if bias is not None:
+        return eager_call("linear", lambda a, w, b: jnp.matmul(a, w) + b, [x, weight, as_tensor(bias)])
+    return eager_call("linear", jnp.matmul, [x, weight])
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return eager_call("dropout_scale", lambda a, p: a * (1 - p), [x], {"p": p})
+        return x
+    key = random_state.next_key()
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    mask = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+    mask_t = Tensor(mask)
+
+    def fn(a, m, p, mode):
+        m = m.astype(a.dtype)
+        if mode == "upscale_in_train":
+            return a * m / (1.0 - p)
+        return a * m
+
+    return eager_call("dropout", fn, [x, mask_t], {"p": p, "mode": mode})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    key = random_state.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    mask = jax.random.bernoulli(key, 1.0 - p, tuple(x.shape))
+    mask_t = Tensor(mask)
+
+    def fn(a, m, p, alpha_p):
+        q = 1.0 - p
+        a_coef = (q + alpha_p**2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        m = m.astype(a.dtype)
+        return a_coef * (a * m + alpha_p * (1 - m)) + b_coef
+
+    return eager_call("alpha_dropout", fn, [x, mask_t], {"p": p, "alpha_p": alpha_p})
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    return _pad_op(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def one_hot(x, num_classes, name=None):
+    x = as_tensor(x)
+    return eager_call(
+        "one_hot",
+        lambda a, n: jax.nn.one_hot(a, n, dtype=jnp.float32),
+        [x],
+        {"n": int(num_classes)},
+        differentiable=False,
+    )
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Reference: lookup_table_v2 (paddle/fluid/operators/lookup_table_v2_op.*).
+
+    On TPU this is a gather; padding_idx rows produce zero vectors and get no
+    gradient (handled by zeroing the row before lookup).
+    """
+    x, weight = as_tensor(x), as_tensor(weight)
+
+    def fn(ids, w, padding_idx):
+        if padding_idx is not None:
+            w = w.at[padding_idx].set(0.0)
+        return jnp.take(w, ids, axis=0)
+
+    return eager_call(
+        "embedding", fn, [x, weight],
+        {"padding_idx": None if padding_idx is None else int(padding_idx)},
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = as_tensor(label)
+
+    def fn(l, epsilon):
+        k = l.shape[-1]
+        return (1 - epsilon) * l + epsilon / k
+
+    if prior_dist is not None:
+        return eager_call(
+            "label_smooth_prior",
+            lambda l, p, epsilon: (1 - epsilon) * l + epsilon * p,
+            [label, as_tensor(prior_dist)],
+            {"epsilon": epsilon},
+        )
+    return eager_call("label_smooth", fn, [label], {"epsilon": epsilon})
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    x = as_tensor(x)
+    if isinstance(size, Tensor):
+        size = size.tolist()
+    nd = x.ndim - 2
+    ch_last = data_format[-1] == "C"
+    spatial = x.shape[2:] if not ch_last else x.shape[1:-1]
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("either size or scale_factor required")
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+        size = [int(s * f) for s, f in zip(spatial, sf)]
+    size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size] * nd)]
+
+    jmode = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "trilinear": "linear",
+        "linear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode]
+
+    def fn(a, size, ch_last, jmode, align_corners):
+        if ch_last:
+            out_shape = (a.shape[0],) + tuple(size) + (a.shape[-1],)
+            axes = tuple(range(1, a.ndim - 1))
+        else:
+            out_shape = a.shape[:2] + tuple(size)
+            axes = tuple(range(2, a.ndim))
+        if jmode == "nearest":
+            # paddle nearest uses floor indexing (align_corners=False)
+            idx = []
+            for ax, s_out in zip(axes, size):
+                s_in = a.shape[ax]
+                ratio = s_in / s_out
+                ix = jnp.floor(jnp.arange(s_out) * ratio).astype(jnp.int32)
+                idx.append((ax, jnp.clip(ix, 0, s_in - 1)))
+            out = a
+            for ax, ix in idx:
+                out = jnp.take(out, ix, axis=ax)
+            return out
+        method = {"linear": "bilinear" if len(axes) == 2 else "linear", "cubic": "bicubic"}[jmode]
+        if len(axes) == 3:
+            method = "trilinear"
+        return jax.image.resize(a, out_shape, method=method)
+
+    return eager_call(
+        "interpolate", fn, [x],
+        {"size": tuple(size), "ch_last": ch_last, "jmode": jmode, "align_corners": align_corners},
+    )
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference paddle/fluid/operators/unfold_op.cc)."""
+    x = as_tensor(x)
+
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) else tuple(paddings)
+    d = _pair(dilations)
+
+    def fn(a, k, s, p, d):
+        n, c, h, w = a.shape
+        if len(p) == 2:
+            pads = ((p[0], p[0]), (p[1], p[1]))
+        else:
+            pads = ((p[0], p[2]), (p[1], p[3]))
+        a = jnp.pad(a, ((0, 0), (0, 0), pads[0], pads[1]))
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s, padding="VALID", rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        # patches: (N, C*kh*kw, oh, ow) → (N, C*kh*kw, L)
+        return patches.reshape(n, patches.shape[1], -1)
+
+    return eager_call("unfold", fn, [x], {"k": k, "s": s, "p": p, "d": d})
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = as_tensor(x)
+
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+    out_hw = _pair(output_sizes)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+
+    def fn(a, out_hw, k, s, p, d):
+        n, ckk, l = a.shape
+        c = ckk // (k[0] * k[1])
+        oh = (out_hw[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (out_hw[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = a.reshape(n, c, k[0], k[1], oh, ow)
+        H = out_hw[0] + 2 * p[0]
+        W = out_hw[1] + 2 * p[1]
+        out = jnp.zeros((n, c, H, W), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi : hi + oh * s[0] : s[0], wj : wj + ow * s[1] : s[1]].add(
+                    cols[:, :, i, j]
+                )
+        return out[:, :, p[0] : H - p[0], p[1] : W - p[1]]
+
+    return eager_call("fold", fn, [x], {"out_hw": out_hw, "k": k, "s": s, "p": p, "d": d})
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    return eager_call(
+        "cosine_similarity",
+        lambda a, b, axis, eps: jnp.sum(a * b, axis=axis)
+        / jnp.maximum(jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis), eps),
+        [as_tensor(x1), as_tensor(x2)],
+        {"axis": axis, "eps": eps},
+    )
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    def fn(a, r, data_format):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return eager_call("pixel_shuffle", fn, [as_tensor(x)], {"r": int(upscale_factor), "data_format": data_format})
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    def fn(a, r, data_format):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+
+    return eager_call("pixel_unshuffle", fn, [as_tensor(x)], {"r": int(downscale_factor), "data_format": data_format})
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a, g, data_format):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, g, c // g).transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return eager_call("channel_shuffle", fn, [as_tensor(x)], {"g": int(groups), "data_format": data_format})
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return eager_call(
+        "normalize",
+        lambda a, p, axis, eps: a
+        / jnp.maximum(jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True), eps),
+        [as_tensor(x)],
+        {"p": p, "axis": axis, "eps": epsilon},
+    )
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = as_tensor(x1), as_tensor(x2), as_tensor(weight)
+
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [x1, x2, weight] + ([as_tensor(bias)] if bias is not None else [])
+
+    def fn2(a, b, w, *rest):
+        return fn(a, b, w, *rest)
+
+    return eager_call("bilinear", fn2, args)

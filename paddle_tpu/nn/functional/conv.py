@@ -1,0 +1,182 @@
+"""Convolution functionals.
+
+Parity: reference ``python/paddle/nn/functional/conv.py`` backed by cuDNN
+(``paddle/fluid/operators/conv_op.*``, ``conv_transpose_op.*``). Here each
+conv is one ``lax.conv_general_dilated`` — XLA tiles it onto the MXU; no
+algorithm search / workspace management is needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import as_tensor, eager_call
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n):
+    """Normalize paddle padding spec → lax padding list of (lo, hi)."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' | 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:  # [before0, after0, before1, after1...] paddle style
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # NCHW-style full spec: take spatial entries
+        spatial = [p for p in padding if tuple(p) != (0, 0)]
+        out = [tuple(p) for p in padding[-n:]]
+        return out
+    return [(int(p), int(p)) for p in padding]
+
+
+def _dim_numbers(nd, channel_last):
+    if nd == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if nd == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nd, data_format, name):
+    x, weight = as_tensor(x), as_tensor(weight)
+    channel_last = data_format[-1] == "C"
+    stride = _tuple(stride, nd)
+    dilation = _tuple(dilation, nd)
+    pad = _padding(padding, nd)
+    dn = _dim_numbers(nd, channel_last)
+
+    def fn(a, w, *rest, stride=None, pad=None, dilation=None, groups=None, dn=None, channel_last=False):
+        # weight layout is paddle OIHW; convert for channel-last dn
+        if dn[1] in ("WIO", "HWIO", "DHWIO"):
+            w = jnp.moveaxis(w, (0, 1), (-1, -2))
+        out = lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=dn,
+        )
+        if rest:
+            b = rest[0]
+            if channel_last:
+                out = out + b.reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + b.reshape((1, -1) + (1,) * (out.ndim - 2))
+        return out
+
+    args = [x, weight] + ([as_tensor(bias)] if bias is not None else [])
+    return eager_call(
+        f"conv{nd}d", fn, args,
+        {
+            "stride": stride,
+            "pad": pad if isinstance(pad, str) else tuple(pad),
+            "dilation": dilation,
+            "groups": int(groups),
+            "dn": dn,
+            "channel_last": channel_last,
+        },
+    )
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df, name)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format, name)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format, name)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, groups, dilation, nd, data_format, output_size, name):
+    x, weight = as_tensor(x), as_tensor(weight)
+    channel_last = data_format[-1] == "C"
+    stride = _tuple(stride, nd)
+    dilation = _tuple(dilation, nd)
+    out_pad = _tuple(output_padding, nd) if output_padding is not None else (0,) * nd
+    pad = _padding(padding, nd)
+    dn = _dim_numbers(nd, channel_last)
+
+    def fn(a, w, *rest, stride=None, pad=None, dilation=None, groups=None, dn=None, channel_last=False, out_pad=None):
+        # paddle conv_transpose weight layout: (in, out/groups, *k)
+        # grad-of-conv formulation: lax.conv_transpose with IO swap
+        if isinstance(pad, str):
+            pads = pad
+        else:
+            # convert forward-conv padding p to transpose padding:
+            # lo = k_eff - 1 - p_lo ; hi = k_eff - 1 - p_hi + out_pad
+            k = w.shape[2:]
+            pads = [
+                (
+                    dilation[i] * (k[i] - 1) - pad[i][0],
+                    dilation[i] * (k[i] - 1) - pad[i][1] + out_pad[i],
+                )
+                for i in range(len(k))
+            ]
+        # weight (I, O/g, *k) → flip spatial, to (O, I/g...) conv on dilated input
+        w_flip = jnp.flip(w, axis=tuple(range(2, w.ndim)))
+        if groups > 1:
+            # split groups: w (I, O/g, *k) with I = g * (I/g)
+            i_per_g = w.shape[0] // groups
+            w_g = w_flip.reshape((groups, i_per_g) + w.shape[1:])
+            w_g = jnp.swapaxes(w_g, 1, 2)  # (g, O/g, I/g, *k)
+            w_oihw = w_g.reshape((w.shape[1] * groups, i_per_g) + w.shape[2:])
+        else:
+            w_oihw = jnp.swapaxes(w_flip, 0, 1)
+        if dn[1] in ("WIO", "HWIO", "DHWIO"):
+            w_oihw = jnp.moveaxis(w_oihw, (0, 1), (-1, -2))
+        out = lax.conv_general_dilated(
+            a, w_oihw, window_strides=(1,) * len(stride), padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            feature_group_count=groups, dimension_numbers=dn,
+        )
+        if rest:
+            b = rest[0]
+            if channel_last:
+                out = out + b.reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + b.reshape((1, -1) + (1,) * (out.ndim - 2))
+        return out
+
+    args = [x, weight] + ([as_tensor(bias)] if bias is not None else [])
+    out = eager_call(
+        f"conv{nd}d_transpose", fn, args,
+        {
+            "stride": stride,
+            "pad": pad if isinstance(pad, str) else tuple(pad),
+            "dilation": dilation,
+            "groups": int(groups),
+            "dn": dn,
+            "channel_last": channel_last,
+            "out_pad": out_pad,
+        },
+    )
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, groups, dilation, 1, df, output_size, name)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, groups, dilation, 2, data_format, output_size, name)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCDHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, groups, dilation, 3, data_format, output_size, name)

@@ -1,0 +1,354 @@
+"""Loss functionals.
+
+Parity: reference ``python/paddle/nn/functional/loss.py`` backed by
+``paddle/fluid/operators/{softmax_with_cross_entropy,bce_loss,...}_op.*``.
+Softmax+CE is computed fused-in-log-space (the reference's
+softmax_with_cross_entropy kernel) — one pass, numerically stable, XLA fuses.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import as_tensor, eager_call
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    input, label = as_tensor(input), as_tensor(label)
+    inputs = [input, label]
+    has_w = weight is not None
+    if has_w:
+        inputs.append(as_tensor(weight))
+
+    def fn(logits, lab, *w, ignore_index=-100, reduction="mean", soft_label=False,
+           axis=-1, use_softmax=True, label_smoothing=0.0, has_w=False):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logp.ndim:
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            valid = lab_i != ignore_index
+            safe_lab = jnp.where(valid, lab_i, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_lab, axis), axis=axis
+            ).squeeze(axis)
+            if label_smoothing > 0:
+                smooth_loss = -jnp.mean(logp, axis=axis)
+                loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            if has_w:
+                loss = loss * jnp.take(w[0], safe_lab)
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                if has_w:
+                    denom = jnp.sum(jnp.where(valid, jnp.take(w[0], safe_lab), 0.0))
+                else:
+                    denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return eager_call(
+        "cross_entropy", fn, inputs,
+        {
+            "ignore_index": ignore_index, "reduction": reduction,
+            "soft_label": soft_label, "axis": axis, "use_softmax": use_softmax,
+            "label_smoothing": label_smoothing, "has_w": has_w,
+        },
+    )
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    inputs = [input, label]
+    has_w = weight is not None
+    if has_w:
+        inputs.append(as_tensor(weight))
+
+    def fn(logp, lab, *w, ignore_index=-100, reduction="mean", has_w=False):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        loss = -picked
+        wts = jnp.take(w[0], safe) if has_w else jnp.ones_like(loss)
+        loss = jnp.where(valid, loss * wts, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, wts, 0.0)), 1e-12)
+        return _reduce(loss, reduction)
+
+    return eager_call(
+        "nll_loss", fn, inputs,
+        {"ignore_index": ignore_index, "reduction": reduction, "has_w": has_w},
+    )
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return eager_call(
+        "mse_loss",
+        lambda a, b, reduction: _reduce(jnp.square(a - b), reduction),
+        [as_tensor(input), as_tensor(label)],
+        {"reduction": reduction},
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return eager_call(
+        "l1_loss",
+        lambda a, b, reduction: _reduce(jnp.abs(a - b), reduction),
+        [as_tensor(input), as_tensor(label)],
+        {"reduction": reduction},
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b, reduction, delta):
+        diff = jnp.abs(a - b)
+        loss = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return eager_call(
+        "smooth_l1_loss", fn, [as_tensor(input), as_tensor(label)],
+        {"reduction": reduction, "delta": delta},
+    )
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    inputs = [as_tensor(input), as_tensor(label)]
+    has_w = weight is not None
+    if has_w:
+        inputs.append(as_tensor(weight))
+
+    def fn(p, y, *w, reduction="mean", has_w=False):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if has_w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    return eager_call("bce", fn, inputs, {"reduction": reduction, "has_w": has_w})
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    inputs = [as_tensor(logit), as_tensor(label)]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        inputs.append(as_tensor(weight))
+    if has_pw:
+        inputs.append(as_tensor(pos_weight))
+
+    def fn(x, y, *rest, reduction="mean", has_w=False, has_pw=False):
+        i = 0
+        w = rest[i] if has_w else None
+        if has_w:
+            i += 1
+        pw = rest[i] if has_pw else None
+        # stable: max(x,0) - x*y + log(1+exp(-|x|)), pos_weight folds into y term
+        if pw is not None:
+            log_weight = (pw - 1) * y + 1
+            loss = (1 - y) * x + log_weight * (jnp.logaddexp(0.0, -jnp.abs(x)) + jnp.maximum(-x, 0.0))
+        else:
+            loss = jnp.maximum(x, 0) - x * y + jnp.logaddexp(0.0, -jnp.abs(x))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return eager_call(
+        "bce_with_logits", fn, inputs,
+        {"reduction": reduction, "has_w": has_w, "has_pw": has_pw},
+    )
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def fn(logp, y, reduction):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return eager_call("kl_div", fn, [as_tensor(input), as_tensor(label)], {"reduction": reduction})
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y, margin, reduction):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+
+    return eager_call(
+        "margin_ranking_loss", fn,
+        [as_tensor(input), as_tensor(other), as_tensor(label)],
+        {"margin": margin, "reduction": reduction},
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, y, margin, reduction):
+        loss = jnp.where(y == 1.0, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+
+    return eager_call(
+        "hinge_embedding_loss", fn, [as_tensor(input), as_tensor(label)],
+        {"margin": margin, "reduction": reduction},
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y, margin, reduction):
+        cos = jnp.sum(a * b, axis=-1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return eager_call(
+        "cosine_embedding_loss", fn,
+        [as_tensor(input1), as_tensor(input2), as_tensor(label)],
+        {"margin": margin, "reduction": reduction},
+    )
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-06, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg, margin, p, epsilon, swap, reduction):
+        d_pos = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        d_neg = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            d_swap = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            d_neg = jnp.minimum(d_neg, d_swap)
+        return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+
+    return eager_call(
+        "triplet_margin_loss", fn,
+        [as_tensor(input), as_tensor(positive), as_tensor(negative)],
+        {"margin": margin, "p": p, "epsilon": epsilon, "swap": swap, "reduction": reduction},
+    )
+
+
+def log_loss(input, label, epsilon=0.0001, name=None):
+    def fn(p, y, epsilon):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return eager_call("log_loss", fn, [as_tensor(input), as_tensor(label)], {"epsilon": epsilon})
+
+
+def square_error_cost(input, label):
+    return eager_call(
+        "square_error_cost", lambda a, b: jnp.square(a - b), [as_tensor(input), as_tensor(label)]
+    )
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via the standard alpha-recursion in log space (lax.scan over time).
+
+    Reference: warpctc op (paddle/fluid/operators/warpctc_op.*).
+    log_probs: (T, N, C) logits (softmax applied internally, paddle semantics).
+    """
+    lp, lab = as_tensor(log_probs), as_tensor(labels)
+    il, ll = as_tensor(input_lengths), as_tensor(label_lengths)
+
+    def fn(logits, labels, in_len, lab_len, blank, reduction):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        T, N, C = logp.shape
+        S = labels.shape[1]
+        ext_len = 2 * S + 1
+        labels_i = labels.astype(jnp.int32)
+        ext = jnp.full((N, ext_len), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(labels_i)
+        neg_inf = jnp.asarray(-1e30, logp.dtype)
+
+        def emit(t_logp, s_ext):
+            return jnp.take_along_axis(t_logp, s_ext, axis=1)  # (N, ext_len)
+
+        alpha0 = jnp.full((N, ext_len), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+        first_lab = emit(logp[0], ext)[:, 1]
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, t_logp):
+            a_shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            new_alpha = merged + emit(t_logp, ext)
+            return new_alpha, new_alpha
+
+        _, alphas = jax.lax.scan(step, alpha0, logp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T, N, ext)
+
+        t_idx = (in_len.astype(jnp.int32) - 1).reshape(1, N, 1)
+        final = jnp.take_along_axis(alphas, jnp.broadcast_to(t_idx, (1, N, ext_len)), axis=0)[0]
+        last = (2 * lab_len.astype(jnp.int32)).reshape(N, 1)
+        p_last = jnp.take_along_axis(final, last, axis=1)[:, 0]
+        p_prev = jnp.take_along_axis(final, jnp.maximum(last - 1, 0), axis=1)[:, 0]
+        ll_total = jnp.logaddexp(p_last, p_prev)
+        loss = -ll_total
+        return _reduce(loss, reduction)
+
+    return eager_call(
+        "ctc_loss", fn, [lp, lab, il, ll], {"blank": blank, "reduction": reduction}
+    )
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    inputs = [as_tensor(logit), as_tensor(label)]
+    has_norm = normalizer is not None
+    if has_norm:
+        inputs.append(as_tensor(normalizer))
+
+    def fn(x, y, *n, alpha=0.25, gamma=2.0, reduction="sum", has_norm=False):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.logaddexp(0.0, -jnp.abs(x))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if has_norm:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    return eager_call(
+        "sigmoid_focal_loss", fn, inputs,
+        {"alpha": alpha, "gamma": gamma, "reduction": reduction, "has_norm": has_norm},
+    )

@@ -1,0 +1,207 @@
+"""Normalization functionals.
+
+Parity: reference ``python/paddle/nn/functional/norm.py`` backed by
+``paddle/fluid/operators/batch_norm_op.*``, ``layer_norm_op.*``,
+``group_norm_op.*`` (cuDNN); here plain jnp — XLA fuses the reductions.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import as_tensor, eager_call
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-05,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    """Training mode computes batch stats and updates running stats in place
+    (reference: batch_norm op's MeanOut/VarianceOut aliasing)."""
+    x = as_tensor(x)
+    rm, rv = as_tensor(running_mean), as_tensor(running_var)
+    ch_axis = 1 if (data_format.startswith("NC") or data_format == "NCHW") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not (use_global_stats or False)
+
+    if use_batch_stats:
+        # compute batch stats eagerly (needed for the running-stat update)
+        mean = eager_call("bn_mean", lambda a, axes: jnp.mean(a, axis=axes), [x], {"axes": axes})
+        var = eager_call(
+            "bn_var", lambda a, axes: jnp.var(a, axis=axes), [x], {"axes": axes}
+        )
+        # update running stats (no grad; in-place buffer update)
+        n = x.size // x.shape[ch_axis]
+        unbiased = var._data * (n / max(n - 1, 1))
+        rm._set_data(rm._data * momentum + mean._data * (1 - momentum))
+        rv._set_data(rv._data * momentum + unbiased * (1 - momentum))
+        stats_m, stats_v = mean, var
+    else:
+        stats_m, stats_v = rm, rv
+
+    inputs = [x, stats_m, stats_v]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        inputs.append(as_tensor(weight))
+    if has_b:
+        inputs.append(as_tensor(bias))
+
+    def fn(a, m, v, *wb, epsilon=1e-5, ch_axis=1, has_w=False, has_b=False):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        m = m.reshape(shape)
+        v = v.reshape(shape)
+        out = (a - m) / jnp.sqrt(v + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    return eager_call(
+        "batch_norm", fn, inputs,
+        {"epsilon": epsilon, "ch_axis": ch_axis, "has_w": has_w, "has_b": has_b},
+    )
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    x = as_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+    inputs = [x]
+    has_w, has_b = weight is not None, bias is not None
+    if has_w:
+        inputs.append(as_tensor(weight))
+    if has_b:
+        inputs.append(as_tensor(bias))
+
+    def fn(a, *wb, n_axes=1, epsilon=1e-5, has_w=False, has_b=False):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) / jnp.sqrt(v + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+
+    return eager_call(
+        "layer_norm", fn, inputs,
+        {"n_axes": n_axes, "epsilon": epsilon, "has_w": has_w, "has_b": has_b},
+    )
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    ch_last = data_format[-1] == "C"
+    inputs = [x]
+    has_w, has_b = weight is not None, bias is not None
+    if has_w:
+        inputs.append(as_tensor(weight))
+    if has_b:
+        inputs.append(as_tensor(bias))
+
+    def fn(a, *wb, g=1, epsilon=1e-5, ch_last=False, has_w=False, has_b=False):
+        if ch_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[:2]
+        grouped = a_t.reshape((n, g, c // g) + a_t.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        m = jnp.mean(grouped, axis=axes, keepdims=True)
+        v = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - m) / jnp.sqrt(v + epsilon)).reshape(a_t.shape)
+        shape = (1, c) + (1,) * (a_t.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        if ch_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return eager_call(
+        "group_norm", fn, inputs,
+        {"g": int(num_groups), "epsilon": epsilon, "ch_last": ch_last, "has_w": has_w, "has_b": has_b},
+    )
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    inputs = [x]
+    has_w, has_b = weight is not None, bias is not None
+    if has_w:
+        inputs.append(as_tensor(weight))
+    if has_b:
+        inputs.append(as_tensor(bias))
+
+    def fn(a, *wb, eps=1e-5, has_w=False, has_b=False):
+        axes = tuple(range(2, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) / jnp.sqrt(v + eps)
+        shape = (1, a.shape[1]) + (1,) * (a.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    return eager_call("instance_norm", fn, inputs, {"eps": eps, "has_w": has_w, "has_b": has_b})
+
+
+def local_response_norm(x, size, alpha=0.0001, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    x = as_tensor(x)
+
+    def fn(a, size, alpha, beta, k):
+        sq = jnp.square(a)
+        half = size // 2
+        c = a.shape[1]
+        pad = jnp.pad(sq, ((0, 0), (half, size - 1 - half)) + ((0, 0),) * (a.ndim - 2))
+        acc = sum(pad[:, i : i + c] for i in range(size))
+        return a / jnp.power(k + alpha * acc / size, beta) * 1.0
+
+    return eager_call(
+        "local_response_norm", fn, [x], {"size": size, "alpha": alpha, "beta": beta, "k": k}
+    )
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    import jax
+
+    w = as_tensor(weight)
+
+    def fn(W, dim, power_iters, eps):
+        Wm = jnp.moveaxis(W, dim, 0).reshape(W.shape[dim], -1)
+        u = jnp.ones((Wm.shape[0],), W.dtype)
+        v = jnp.ones((Wm.shape[1],), W.dtype)
+        for _ in range(power_iters):
+            v = Wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = Wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ Wm @ v
+        return W / sigma
+
+    return eager_call("spectral_norm", fn, [w], {"dim": dim, "power_iters": power_iters, "eps": eps})

@@ -1,0 +1,206 @@
+"""Pooling functionals.
+
+Parity: reference ``python/paddle/nn/functional/pooling.py`` backed by
+``paddle/fluid/operators/pool_op.*`` — here ``lax.reduce_window``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import as_tensor, eager_call
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else list(v) * n)[:n])
+    return tuple(int(v) for _ in range(n))
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    return [(int(p), int(p)) for p in padding]
+
+
+def _pool(x, kernel, stride, padding, nd, op, data_format, ceil_mode=False, exclusive=True, count_include_pad=False, name="pool"):
+    x = as_tensor(x)
+    channel_last = data_format[-1] == "C"
+    kernel = _tuple(kernel, nd)
+    stride = _tuple(stride if stride is not None else kernel, nd)
+    pads = _pads(padding, nd)
+
+    def fn(a, kernel, stride, pads, op, channel_last, ceil_mode, exclusive):
+        nd_ = len(kernel)
+        if channel_last:
+            window = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            full_pads = pads if isinstance(pads, str) else [(0, 0)] + list(pads) + [(0, 0)]
+        else:
+            window = (1, 1) + kernel
+            strides = (1, 1) + stride
+            full_pads = pads if isinstance(pads, str) else [(0, 0), (0, 0)] + list(pads)
+        if isinstance(full_pads, str):
+            spatial = a.shape[1:-1] if channel_last else a.shape[2:]
+            if full_pads == "SAME":
+                fp = []
+                for s_in, k, s in zip(spatial, kernel, stride):
+                    out = -(-s_in // s)
+                    total = max(0, (out - 1) * s + k - s_in)
+                    fp.append((total // 2, total - total // 2))
+                full_pads = ([(0, 0)] + fp + [(0, 0)]) if channel_last else ([(0, 0), (0, 0)] + fp)
+            else:
+                full_pads = [(0, 0)] * a.ndim
+        if ceil_mode:
+            spatial_ax = range(1, a.ndim - 1) if channel_last else range(2, a.ndim)
+            fp = list(full_pads)
+            for i, ax in enumerate(spatial_ax):
+                s_in = a.shape[ax] + fp[ax][0] + fp[ax][1]
+                k, s = kernel[i], stride[i]
+                rem = (s_in - k) % s
+                if rem:
+                    fp[ax] = (fp[ax][0], fp[ax][1] + (s - rem))
+            full_pads = fp
+        if op == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return lax.reduce_window(a, init, lax.max, window, strides, full_pads)
+        # avg
+        summed = lax.reduce_window(a, 0.0, lax.add, window, strides, full_pads)
+        if exclusive:
+            ones = jnp.ones(a.shape, a.dtype)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, full_pads)
+            return summed / counts
+        return summed / np.prod(kernel)
+
+    return eager_call(
+        name, fn, [x],
+        {
+            "kernel": kernel, "stride": stride,
+            "pads": pads if isinstance(pads, str) else tuple(pads),
+            "op": op, "channel_last": channel_last,
+            "ceil_mode": ceil_mode, "exclusive": exclusive,
+        },
+    )
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "max", "NCW", ceil_mode, name="max_pool1d")
+    return (out, None) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, "max", data_format, ceil_mode, name="max_pool2d")
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding, data_format)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, "max", data_format, ceil_mode, name="max_pool3d")
+    return (out, None) if return_mask else out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", "NCW", ceil_mode, exclusive, name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", data_format, ceil_mode, exclusive, name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", data_format, ceil_mode, exclusive, name="avg_pool3d")
+
+
+def _max_pool_indices(x, kernel_size, stride, padding, data_format):
+    from ...core.tensor import Tensor
+
+    x = as_tensor(x)
+    k = _tuple(kernel_size, 2)
+    s = _tuple(stride if stride is not None else kernel_size, 2)
+    a = np.asarray(x._data)
+    if data_format != "NCHW":
+        a = np.moveaxis(a, -1, 1)
+    n, c, h, w = a.shape
+    oh = (h - k[0]) // s[0] + 1
+    ow = (w - k[1]) // s[1] + 1
+    idx = np.zeros((n, c, oh, ow), dtype=np.int64)
+    for i in range(oh):
+        for j in range(ow):
+            win = a[:, :, i * s[0] : i * s[0] + k[0], j * s[1] : j * s[1] + k[1]].reshape(n, c, -1)
+            am = win.argmax(-1)
+            r, cc = np.unravel_index(am, k)
+            idx[:, :, i, j] = (i * s[0] + r) * w + (j * s[1] + cc)
+    return Tensor(idx)
+
+
+def _adaptive_windows(in_size, out_size):
+    # paddle adaptive pooling: start = floor(i*in/out), end = ceil((i+1)*in/out)
+    starts = [int(np.floor(i * in_size / out_size)) for i in range(out_size)]
+    ends = [int(np.ceil((i + 1) * in_size / out_size)) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, nd, op, data_format, name):
+    x = as_tensor(x)
+    channel_last = data_format[-1] == "C"
+    spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+    out_size = _tuple(output_size, nd)
+    out_size = tuple(o if o is not None else s for o, s in zip(out_size, spatial))
+
+    if all(s % o == 0 for s, o in zip(spatial, out_size)):
+        k = tuple(s // o for s, o in zip(spatial, out_size))
+        return _pool(x, k, k, 0, nd, op, data_format, name=name)
+
+    def fn(a, out_size, op, channel_last):
+        axes = list(range(1, a.ndim - 1)) if channel_last else list(range(2, a.ndim))
+        out = a
+        for dim_i, ax in enumerate(axes):
+            in_size = out.shape[ax]
+            starts, ends = _adaptive_windows(in_size, out_size[dim_i])
+            slices = []
+            for st, en in zip(starts, ends):
+                window = lax.slice_in_dim(out, st, en, axis=ax)
+                red = jnp.max(window, axis=ax, keepdims=True) if op == "max" else jnp.mean(window, axis=ax, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=ax)
+        return out
+
+    return eager_call(name, fn, [x], {"out_size": out_size, "op": op, "channel_last": channel_last})
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", "NCW", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format, "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format, "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "max", "NCW", "adaptive_max_pool1d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "max", "NCHW", "adaptive_max_pool2d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "max", "NCDHW", "adaptive_max_pool3d")
+    return (out, None) if return_mask else out

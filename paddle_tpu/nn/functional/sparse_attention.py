@@ -1,0 +1,45 @@
+"""Sparse (block-masked) attention.
+
+Parity: reference ``python/paddle/nn/functional/sparse_attention.py``
+(CSR-masked attention CUDA op). TPU-native: block-sparse masking inside a
+dense softmax-attention — XLA removes masked blocks' contribution; a Pallas
+block-sparse kernel is the perf path for long sequences (see ring attention
+in paddle_tpu/distributed for the scaled path).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import as_tensor, eager_call
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns, name=None):
+    """q,k,v: (B, H, T, D); offset/columns describe a per-row CSR mask."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    off, cols = as_tensor(sparse_csr_offset), as_tensor(sparse_csr_columns)
+
+    def fn(q, k, v, off, cols):
+        B, H, T, D = q.shape
+        scale = 1.0 / math.sqrt(D)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        # CSR → dense boolean mask
+        off_i = off.astype(jnp.int32)
+        cols_i = cols.astype(jnp.int32)
+        nnz = cols_i.shape[-1]
+        row_of = jnp.searchsorted(off_i[0, 0], jnp.arange(nnz), side="right") - 1
+
+        def build_mask(off_row, cols_row):
+            counts = off_row[1:] - off_row[:-1]
+            rows = jnp.repeat(jnp.arange(T), counts, total_repeat_length=cols_row.shape[0])
+            m = jnp.zeros((T, T), bool).at[rows, cols_row].set(True)
+            return m
+
+        mask = jax.vmap(jax.vmap(build_mask))(off_i, cols_i)
+        scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    return eager_call("sparse_attention", fn, [q, k, v, off, cols])

@@ -1,0 +1,184 @@
+"""Weight initializers.
+
+Parity: reference ``python/paddle/nn/initializer/`` + fluid initializers
+(``python/paddle/fluid/initializer.py``). Functional: each initializer is a
+callable returning a jax array for a given shape/dtype.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import random as random_state
+
+
+def _fan(shape):
+    shape = tuple(shape)
+    if len(shape) < 2:
+        fan_in = fan_out = int(shape[0]) if shape else 1
+    else:
+        receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        fan_in = shape[1] * receptive if len(shape) > 2 else shape[0]
+        fan_out = shape[0] * receptive if len(shape) > 2 else shape[1]
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(tuple(shape), self.value, dtype=dtypes.convert_dtype(dtype) or dtypes.get_default_dtype())
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        key = random_state.next_key()
+        dt = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        return jax.random.uniform(key, tuple(shape), dtype=jnp.float32, minval=self.low, maxval=self.high).astype(dt)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        key = random_state.next_key()
+        dt = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        return (jax.random.normal(key, tuple(shape), dtype=jnp.float32) * self.std + self.mean).astype(dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        key = random_state.next_key()
+        dt = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        return (
+            jax.random.truncated_normal(key, -2.0, 2.0, tuple(shape), dtype=jnp.float32) * self.std + self.mean
+        ).astype(dt)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fan(shape)
+        fi = self._fan_in or fi
+        fo = self._fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fan(shape)
+        fi = self._fan_in or fi
+        fo = self._fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std)(shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fan(shape)
+        fi = self._fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fan(shape)
+        fi = self._fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
+        return Normal(0.0, gain / math.sqrt(fi))(shape, dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        from ..core.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = jnp.asarray(np.asarray(v), dtype=dtypes.convert_dtype(dtype) or None)
+        assert tuple(arr.shape) == tuple(shape), f"Assign shape {arr.shape} != {shape}"
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        key = random_state.next_key()
+        dt = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        return (jax.nn.initializers.orthogonal(scale=self.gain)(key, tuple(shape), jnp.float32)).astype(dt)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        dt = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        arr = np.zeros(shape, dtype=np.float32)
+        o, i = shape[0], shape[1]
+        mins = min(o // self.groups, i)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for d in range(mins):
+                arr[(g * (o // self.groups) + d, d) + tuple(centers)] = 1.0
+        return jnp.asarray(arr, dtype=dt)
+
+
+# default global initializer (reference: fluid.initializer._global_weight_initializer)
+_default_weight_init = XavierUniform()
+_default_bias_init = Constant(0.0)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _default_weight_init, _default_bias_init
+    _default_weight_init = weight_init
+    if bias_init is not None:
+        _default_bias_init = bias_init
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0, "conv3d": 1.0,
+        "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    return gains[nonlinearity]

@@ -1,0 +1,329 @@
+"""RNN layers.
+
+Parity: reference ``python/paddle/nn/layer/rnn.py`` (+ C++ ``rnn_op`` /
+cuDNN RNN kernels). TPU-native: the time loop is a ``lax.scan`` inside one
+traced op so XLA compiles a single fused loop — no per-step dispatch.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import as_tensor, eager_call
+from ...core.tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from ..param_attr import ParamAttr
+from .common import LayerList
+from .layers import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        from ...ops.creation import full
+
+        batch = batch_ref.shape[batch_dim_idx]
+        state_shape = shape or getattr(self, "state_shape", None)
+
+        def build(s):
+            return full([batch] + list(s), init_value)
+
+        if isinstance(state_shape, tuple) and state_shape and isinstance(state_shape[0], (list, tuple)):
+            return tuple(build(s) for s in state_shape)
+        return build(state_shape)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], attr=ParamAttr._to_attr(weight_ih_attr), default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], attr=ParamAttr._to_attr(weight_hh_attr), default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], attr=ParamAttr._to_attr(bias_ih_attr), is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], attr=ParamAttr._to_attr(bias_hh_attr), is_bias=True, default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self.state_shape = (hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, w_ih, w_hh, b_ih, b_hh):
+            return act(x @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+
+        out = eager_call(
+            "simple_rnn_cell", fn,
+            [as_tensor(inputs), as_tensor(states), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh],
+        )
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], attr=ParamAttr._to_attr(weight_ih_attr), default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], attr=ParamAttr._to_attr(weight_hh_attr), default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=ParamAttr._to_attr(bias_ih_attr), is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=ParamAttr._to_attr(bias_hh_attr), is_bias=True, default_initializer=u)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.state_shape = ((hidden_size,), (hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def fn(x, h, c, w_ih, w_hh, b_ih, b_hh):
+            gates = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = f * c + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        out = eager_call(
+            "lstm_cell", fn,
+            [as_tensor(inputs), as_tensor(h), as_tensor(c), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh],
+        )
+        return out[0], (out[0], out[1])
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], attr=ParamAttr._to_attr(weight_ih_attr), default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], attr=ParamAttr._to_attr(weight_hh_attr), default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=ParamAttr._to_attr(bias_ih_attr), is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=ParamAttr._to_attr(bias_hh_attr), is_bias=True, default_initializer=u)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.state_shape = (hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, w_ih, w_hh, b_ih, b_hh):
+            gx = x @ w_ih.T + b_ih
+            gh = h @ w_hh.T + b_hh
+            rx, zx, cx = jnp.split(gx, 3, axis=-1)
+            rh, zh, ch = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(rx + rh)
+            z = jax.nn.sigmoid(zx + zh)
+            c = jnp.tanh(cx + r * ch)
+            return (1 - z) * c + z * h
+
+        out = eager_call(
+            "gru_cell", fn,
+            [as_tensor(inputs), as_tensor(states), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh],
+        )
+        return out, out
+
+
+def _scan_layer(cell_kind, x, h0, c0, params, reverse=False):
+    """One direction of one RNN layer as a lax.scan (x: (B, T, I))."""
+    w_ih, w_hh, b_ih, b_hh = params
+
+    def lstm_step(carry, xt):
+        h, c = carry
+        gates = xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    def gru_step(carry, xt):
+        h = carry
+        gx = xt @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        rx, zx, cx = jnp.split(gx, 3, axis=-1)
+        rh, zh, ch = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(rx + rh)
+        z = jax.nn.sigmoid(zx + zh)
+        c = jnp.tanh(cx + r * ch)
+        h2 = (1 - z) * c + z * h
+        return h2, h2
+
+    def rnn_step(carry, xt):
+        h = carry
+        h2 = jnp.tanh(xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+        return h2, h2
+
+    xs = jnp.swapaxes(x, 0, 1)  # (T, B, I)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+    if cell_kind == "lstm":
+        (hT, cT), ys = jax.lax.scan(lstm_step, (h0, c0), xs)
+    elif cell_kind == "gru":
+        hT, ys = jax.lax.scan(gru_step, h0, xs)
+        cT = None
+    else:
+        hT, ys = jax.lax.scan(rnn_step, h0, xs)
+        cT = None
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    return jnp.swapaxes(ys, 0, 1), hT, cT
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"lstm": 4, "gru": 3, "rnn": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for direction_i in range(self.bidirect):
+                in_sz = input_size if layer == 0 else hidden_size * self.bidirect
+                suffix = f"_l{layer}" + ("_rev" if direction_i else "")
+                w_ih = self.create_parameter([gate_mult * hidden_size, in_sz], default_initializer=u)
+                w_hh = self.create_parameter([gate_mult * hidden_size, hidden_size], default_initializer=u)
+                b_ih = self.create_parameter([gate_mult * hidden_size], is_bias=True, default_initializer=u)
+                b_hh = self.create_parameter([gate_mult * hidden_size], is_bias=True, default_initializer=u)
+                for n, p in (("weight_ih", w_ih), ("weight_hh", w_hh), ("bias_ih", b_ih), ("bias_hh", b_hh)):
+                    self.add_parameter(n + suffix, p)
+                self._all_weights.append((w_ih, w_hh, b_ih, b_hh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = as_tensor(inputs)
+        if self.time_major:
+            x = x.transpose([1, 0, 2])
+        B = x.shape[0]
+        n_states = self.num_layers * self.bidirect
+        if initial_states is None:
+            from ...ops.creation import zeros
+
+            h0 = zeros([n_states, B, self.hidden_size])
+            c0 = zeros([n_states, B, self.hidden_size]) if self.mode == "lstm" else None
+        else:
+            if self.mode == "lstm":
+                h0, c0 = initial_states
+            else:
+                h0, c0 = initial_states, None
+
+        flat_params = [p for group in self._all_weights for p in group]
+        tensor_args = [x, h0] + ([c0] if c0 is not None else []) + flat_params
+
+        mode = self.mode
+        num_layers = self.num_layers
+        bidirect = self.bidirect
+        has_c = c0 is not None
+        dropout = self.dropout
+        training = self.training
+
+        def fn(xa, h0a, *rest, mode=mode, num_layers=num_layers, bidirect=bidirect, has_c=has_c):
+            if has_c:
+                c0a, params = rest[0], rest[1:]
+            else:
+                c0a, params = None, rest
+            groups = [params[i * 4 : (i + 1) * 4] for i in range(num_layers * bidirect)]
+            out = xa
+            h_finals, c_finals = [], []
+            gi = 0
+            for layer in range(num_layers):
+                outs_dir = []
+                for d in range(bidirect):
+                    g = groups[gi]
+                    h_init = h0a[gi]
+                    c_init = c0a[gi] if has_c else None
+                    ys, hT, cT = _scan_layer(mode, out, h_init, c_init, g, reverse=(d == 1))
+                    outs_dir.append(ys)
+                    h_finals.append(hT)
+                    if has_c:
+                        c_finals.append(cT)
+                    gi += 1
+                out = outs_dir[0] if bidirect == 1 else jnp.concatenate(outs_dir, axis=-1)
+            h_final = jnp.stack(h_finals)
+            if has_c:
+                return out, h_final, jnp.stack(c_finals)
+            return out, h_final
+
+        outs = eager_call(f"{mode}_rnn", fn, tensor_args)
+        y = outs[0]
+        if self.time_major:
+            y = y.transpose([1, 0, 2])
+        if self.mode == "lstm":
+            return y, (outs[1], outs[2])
+        return y, outs[1]
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        super().__init__("rnn", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("lstm", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("gru", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class RNN(Layer):
+    """Wrap a cell into a scan over time (reference nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        x = as_tensor(inputs)
+        if self.time_major:
+            x = x.transpose([1, 0, 2])
+        T = x.shape[1]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = []
+        for t in steps:
+            out, states = self.cell(x[:, t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...ops.manipulation import stack
+
+        y = stack(outs, axis=1)
+        if self.time_major:
+            y = y.transpose([1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (initial_states or (None, None))
+        y_fw, s_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        y_bw, s_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        from ...ops.manipulation import concat
+
+        return concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)

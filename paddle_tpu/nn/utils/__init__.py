@@ -1,0 +1,77 @@
+"""paddle.nn.utils parity (weight_norm, spectral_norm wrappers, vector ops)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+def parameters_to_vector(parameters, name=None):
+    arrs = [p._data.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(arrs))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(np.asarray(vec._data[offset : offset + n]).reshape(p.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| (reference nn/utils/weight_norm_hook.py)."""
+    import jax
+
+    w = getattr(layer, name)
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    norm = jnp.sqrt(jnp.sum(jnp.square(w._data), axis=axes, keepdims=True))
+    g = layer.create_parameter(list(norm.shape), default_initializer=lambda s, d: norm)
+    v = layer.create_parameter(list(w.shape), default_initializer=lambda s, d: w._data)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    layer._parameters.pop(name, None)
+
+    def hook(l, inputs):
+        from ...core.dispatch import eager_call
+
+        def fn(gv, vv):
+            n = jnp.sqrt(jnp.sum(jnp.square(vv), axis=axes, keepdims=True))
+            return gv * vv / jnp.maximum(n, 1e-12)
+
+        new_w = eager_call("weight_norm", fn, [l._parameters[name + "_g"], l._parameters[name + "_v"]])
+        object.__setattr__(l, name, new_w)
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    g = layer._parameters.pop(name + "_g", None)
+    v = layer._parameters.pop(name + "_v", None)
+    if g is not None and v is not None:
+        axes = tuple(i for i in range(v.ndim) if i != 0)
+        n = jnp.sqrt(jnp.sum(jnp.square(v._data), axis=axes, keepdims=True))
+        w = layer.create_parameter(list(v.shape), default_initializer=lambda s, d: g._data * v._data / n)
+        layer.add_parameter(name, w)
+        object.__setattr__(layer, name, w)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    from .. import functional as F
+
+    if dim is None:
+        dim = 0
+
+    def hook(l, inputs):
+        w = l._parameters.get(name + "_orig", l._parameters.get(name))
+        object.__setattr__(l, name, F.spectral_norm(w, dim, n_power_iterations, eps))
+
+    if name in layer._parameters:
+        layer.add_parameter(name + "_orig", layer._parameters.pop(name))
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer

@@ -1,0 +1,115 @@
+"""Op library + Tensor method attachment.
+
+The reference attaches tensor methods by monkey-patching VarBase
+(``python/paddle/fluid/dygraph/varbase_patch_methods.py``) and the math-op
+dunder set (``python/paddle/fluid/dygraph/math_op_patch.py``); we do the same
+onto our eager Tensor so ``x + y``, ``x.sum()``, ``x[i]`` behave identically.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import creation, linalg, manipulation, math
+from ..core.tensor import Tensor
+
+
+def _attach(name, fn):
+    setattr(Tensor, name, fn)
+
+
+def monkey_patch_tensor():
+    m, mp, li, cr = math, manipulation, linalg, creation
+
+    # operators
+    _attach("__add__", lambda self, o: m.add(self, o))
+    _attach("__radd__", lambda self, o: m.add(self, o))
+    _attach("__sub__", lambda self, o: m.subtract(self, o))
+    _attach("__rsub__", lambda self, o: m.subtract(o if isinstance(o, Tensor) else creation.full_like(self, o), self))
+    _attach("__mul__", lambda self, o: m.multiply(self, o))
+    _attach("__rmul__", lambda self, o: m.multiply(self, o))
+    _attach("__truediv__", lambda self, o: m.divide(self, o))
+    _attach(
+        "__rtruediv__",
+        lambda self, o: m.divide(o if isinstance(o, Tensor) else creation.full_like(self, o), self),
+    )
+    _attach("__floordiv__", lambda self, o: m.floor_divide(self, o))
+    _attach("__mod__", lambda self, o: m.remainder(self, o))
+    _attach("__pow__", lambda self, o: m.pow(self, o))
+    _attach("__rpow__", lambda self, o: m.pow(creation.full_like(self, o), self))
+    _attach("__neg__", lambda self: m.neg(self))
+    _attach("__abs__", lambda self: m.abs(self))
+    _attach("__matmul__", lambda self, o: m.matmul(self, o))
+    _attach("__rmatmul__", lambda self, o: m.matmul(o, self))
+    _attach("__eq__", lambda self, o: m.equal(self, o))
+    _attach("__ne__", lambda self, o: m.not_equal(self, o))
+    _attach("__lt__", lambda self, o: m.less_than(self, o))
+    _attach("__le__", lambda self, o: m.less_equal(self, o))
+    _attach("__gt__", lambda self, o: m.greater_than(self, o))
+    _attach("__ge__", lambda self, o: m.greater_equal(self, o))
+    _attach("__invert__", lambda self: m.logical_not(self))
+    _attach("__and__", lambda self, o: m.bitwise_and(self, o))
+    _attach("__or__", lambda self, o: m.bitwise_or(self, o))
+    _attach("__xor__", lambda self, o: m.bitwise_xor(self, o))
+    Tensor.__hash__ = lambda self: id(self)
+
+    _attach("__getitem__", lambda self, item: mp.getitem(self, item))
+    _attach("__setitem__", lambda self, item, v: mp.setitem(self, item, v))
+
+    # math methods
+    for name in (
+        "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+        "pow", "matmul", "mm", "dot", "inner", "outer", "bmm", "addmm", "kron",
+        "exp", "log", "log2", "log10", "log1p", "expm1", "sqrt", "rsqrt", "abs",
+        "sign", "floor", "ceil", "round", "trunc", "frac", "sin", "cos", "tan",
+        "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+        "erf", "erfinv", "reciprocal", "square", "digamma", "lgamma", "sigmoid",
+        "clip", "lerp", "maximum", "minimum", "fmax", "fmin", "atan2",
+        "sum", "mean", "max", "min", "prod", "std", "var", "median", "nanmean",
+        "nansum", "logsumexp", "argmax", "argmin", "cumsum", "cumprod", "all",
+        "any", "isnan", "isinf", "isfinite", "equal", "not_equal", "greater_than",
+        "greater_equal", "less_than", "less_equal", "logical_and", "logical_or",
+        "logical_not", "logical_xor", "bitwise_and", "bitwise_or", "bitwise_xor",
+        "bitwise_not", "allclose", "isclose", "equal_all", "cast", "scale",
+        "trace", "diagonal", "dist", "neg", "heaviside",
+    ):
+        _attach(name, getattr(m, name))
+
+    # manipulation methods
+    for name in (
+        "reshape", "reshape_", "transpose", "t", "split", "chunk", "squeeze",
+        "unsqueeze", "flatten", "expand", "expand_as", "broadcast_to", "tile",
+        "repeat_interleave", "flip", "roll", "gather", "gather_nd", "scatter",
+        "scatter_nd_add", "index_select", "index_sample", "index_add",
+        "masked_select", "masked_fill", "topk", "sort", "argsort", "unbind",
+        "unique", "unique_consecutive", "nonzero", "searchsorted", "bincount",
+        "take_along_axis", "put_along_axis", "moveaxis", "as_real", "as_complex",
+        "real", "imag", "conj", "pad", "unstack",
+    ):
+        _attach(name, getattr(mp, name))
+
+    # linalg methods
+    for name in ("cholesky", "inverse", "norm", "matrix_power", "pinv", "solve"):
+        _attach(name, getattr(li, name))
+
+    # creation-style methods
+    _attach("clone", lambda self: cr.clone(self))
+    _attach("fill_", lambda self, v: self.set_value(np.full(self.shape, v, self.dtype)) or self)
+    _attach("zero_", lambda self: self.set_value(np.zeros(self.shape, self.dtype)) or self)
+
+    def _astype(self, dtype):
+        return m.cast(self, dtype)
+
+    _attach("astype", _astype)
+
+    def _item_method(self, *args):
+        return Tensor.item(self, *args)
+
+    # iteration over first axis
+    def _iter(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    _attach("__iter__", _iter)
+
+
+monkey_patch_tensor()

@@ -1,0 +1,251 @@
+"""Tensor creation ops.
+
+Parity surface: reference ``python/paddle/tensor/creation.py`` (zeros/ones/
+full/arange/...) and random ops in ``python/paddle/tensor/random.py``; kernels
+that were per-backend C++/CUDA (e.g. ``paddle/phi/kernels/gpu/full_kernel.cu``)
+are jnp/XLA here.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import random as random_state
+from ..core.tensor import Tensor
+from ..core.dispatch import as_tensor, eager_call
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else dtypes.get_default_dtype()
+    return dtypes.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = dtypes.get_default_dtype() if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype=_dt(dtype) if dtype else None))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.zeros_like(x._data, dtype=_dt(dtype, x.dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.ones_like(x._data, dtype=_dt(dtype, x.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=_dt(dtype, x.dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or dtypes.get_default_dtype()
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(end, Tensor):
+        end = end.item()
+    if isinstance(step, Tensor):
+        step = step.item()
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype, np.dtype("int64")) if dtype else None))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(float(start), float(stop), int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = as_tensor(x)
+
+    def fn(a, offset, padding_value):
+        if a.ndim == 1:
+            d = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                n = a.shape[0] + abs(offset)
+                mask = jnp.eye(n, k=offset, dtype=bool)
+                d = jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+            return d
+        return jnp.diagonal(a, offset=offset)
+
+    return eager_call("diag", fn, [x], {"offset": offset, "padding_value": padding_value})
+
+
+def diagflat(x, offset=0, name=None):
+    x = as_tensor(x)
+    return eager_call("diagflat", lambda a, offset: jnp.diagflat(a, k=offset), [x], {"offset": offset})
+
+
+def tril(x, diagonal=0, name=None):
+    return eager_call("tril", lambda a, diagonal: jnp.tril(a, k=diagonal), [as_tensor(x)], {"diagonal": diagonal})
+
+
+def triu(x, diagonal=0, name=None):
+    return eager_call("triu", lambda a, diagonal: jnp.triu(a, k=diagonal), [as_tensor(x)], {"diagonal": diagonal})
+
+
+def meshgrid(*args, name=None):
+    tensors = [as_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[t._data for t in tensors], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    x = as_tensor(x)
+    out = eager_call("assign", lambda a: a + 0, [x])
+    if output is not None:
+        output._set_data(out._data)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return eager_call("clone", lambda a: a + 0, [as_tensor(x)])
+
+
+def numel(x, name=None):
+    return Tensor(np.int64(as_tensor(x).size))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    inp = as_tensor(input)
+    size = index_num // nshards
+
+    def fn(a, size, shard_id, ignore_value):
+        in_shard = (a // size) == shard_id
+        return jnp.where(in_shard, a % size, ignore_value)
+
+    return eager_call(
+        "shard_index", fn, [inp],
+        {"size": size, "shard_id": shard_id, "ignore_value": ignore_value},
+        differentiable=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Random ops (reference python/paddle/tensor/random.py)
+# ---------------------------------------------------------------------------
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = random_state.next_key()
+    dt = _dt(dtype)
+    arr = jax.random.uniform(key, _shape(shape), dtype=jnp.float32, minval=min, maxval=max)
+    return Tensor(arr.astype(dt))
+
+
+def randn(shape, dtype=None, name=None):
+    return normal(0.0, 1.0, shape, dtype=dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=None, name=None):
+    key = random_state.next_key()
+    dt = _dt(dtype)
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor(mean)._data if isinstance(mean, Tensor) else mean
+        s = as_tensor(std)._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            jnp.shape(m) if hasattr(m, "shape") else (), jnp.shape(s) if hasattr(s, "shape") else ()
+        )
+        arr = jax.random.normal(key, shp, dtype=jnp.float32) * s + m
+        return Tensor(arr.astype(dt))
+    arr = jax.random.normal(key, _shape(shape), dtype=jnp.float32) * std + mean
+    return Tensor(arr.astype(dt))
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    return normal(mean, std, shape, dtype=dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = random_state.next_key()
+    dt = _dt(dtype, np.dtype("int64"))
+    return Tensor(jax.random.randint(key, _shape(shape), low, high, dtype=jnp.int32).astype(dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = as_tensor(x)
+    return randint(low, high, tuple(x.shape), dtype=_dt(dtype, x.dtype))
+
+
+def randperm(n, dtype=None, name=None):
+    key = random_state.next_key()
+    dt = _dt(dtype, np.dtype("int64"))
+    return Tensor(jax.random.permutation(key, n).astype(dt))
+
+
+def bernoulli(x, name=None):
+    x = as_tensor(x)
+    key = random_state.next_key()
+    return Tensor(jax.random.bernoulli(key, x._data.astype(jnp.float32)).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = as_tensor(x)
+    key = random_state.next_key()
+    logits = jnp.log(jnp.maximum(x._data.astype(jnp.float32), 1e-30))
+    if x.ndim == 1:
+        out = jax.random.choice(
+            key, x.shape[0], shape=(num_samples,), replace=replacement, p=x._data / x._data.sum()
+        )
+    else:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(x.shape[0], num_samples) if replacement else None)
+        if not replacement:
+            keys = jax.random.split(key, x.shape[0])
+            out = jnp.stack(
+                [
+                    jax.random.choice(k, x.shape[1], shape=(num_samples,), replace=False, p=row / row.sum())
+                    for k, row in zip(keys, x._data)
+                ]
+            )
+    return Tensor(out.astype(np.int64))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return normal(0.0, 1.0, shape, dtype=dtype)

@@ -1,0 +1,217 @@
+"""Linear algebra ops (paddle.linalg parity).
+
+Parity surface: reference ``python/paddle/tensor/linalg.py`` and C++ kernels
+(``paddle/fluid/operators/{cholesky,svd,qr,eig,inverse,...}_op.cc``, LAPACK
+functors ``paddle/phi/kernels/funcs/lapack/``) — all via jnp.linalg/XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import as_tensor, eager_call
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a, upper):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return eager_call("cholesky", fn, [as_tensor(x)], {"upper": upper})
+
+
+def inv(x, name=None):
+    return eager_call("inv", jnp.linalg.inv, [as_tensor(x)])
+
+
+inverse = inv
+
+
+def det(x, name=None):
+    return eager_call("det", jnp.linalg.det, [as_tensor(x)])
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+
+    return eager_call("slogdet", fn, [as_tensor(x)])
+
+
+def svd(x, full_matrices=False, name=None):
+    def fn(a, full_matrices):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2)
+
+    out = eager_call("svd", fn, [as_tensor(x)], {"full_matrices": full_matrices})
+    return out[0], out[1], out[2]
+
+
+def qr(x, mode="reduced", name=None):
+    def fn(a, mode):
+        return jnp.linalg.qr(a, mode=mode)
+
+    if mode == "r":
+        return eager_call("qr_r", lambda a: jnp.linalg.qr(a, mode="r"), [as_tensor(x)])
+    out = eager_call("qr", fn, [as_tensor(x)], {"mode": mode})
+    return out[0], out[1]
+
+
+def eig(x, name=None):
+    x = as_tensor(x)
+    w, v = np.linalg.eig(np.asarray(x._data))  # general eig: host LAPACK (like reference CPU kernel)
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    def fn(a, UPLO):
+        return jnp.linalg.eigh(a, UPLO=UPLO)
+
+    out = eager_call("eigh", fn, [as_tensor(x)], {"UPLO": UPLO})
+    return out[0], out[1]
+
+
+def eigvals(x, name=None):
+    x = as_tensor(x)
+    return Tensor(np.linalg.eigvals(np.asarray(x._data)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return eager_call("eigvalsh", lambda a, UPLO: jnp.linalg.eigvalsh(a, UPLO=UPLO), [as_tensor(x)], {"UPLO": UPLO})
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+
+    def fn(a, p, axis, keepdim):
+        if axis is None:
+            if p == "fro" or p == 2:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            if p == np.inf:
+                return jnp.max(jnp.abs(a))
+            if p == -np.inf:
+                return jnp.min(jnp.abs(a))
+            if p == 1:
+                return jnp.sum(jnp.abs(a))
+            if p == 0:
+                return jnp.sum((a != 0).astype(a.dtype))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p)), 1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if p == np.inf:
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim), 1.0 / p)
+
+    axis_n = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return eager_call("norm", fn, [x], {"p": p, "axis": axis_n, "keepdim": keepdim})
+
+
+def cond(x, p=None, name=None):
+    x = as_tensor(x)
+    return Tensor(np.linalg.cond(np.asarray(x._data), p=p))
+
+
+def matrix_power(x, n, name=None):
+    return eager_call("matrix_power", lambda a, n: jnp.linalg.matrix_power(a, n), [as_tensor(x)], {"n": int(n)})
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = as_tensor(x)
+    return Tensor(
+        np.linalg.matrix_rank(np.asarray(x._data, dtype=np.float64), tol=tol, hermitian=hermitian).astype(np.int64)
+    )
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return eager_call(
+        "pinv", lambda a, rcond, hermitian: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+        [as_tensor(x)], {"rcond": rcond, "hermitian": hermitian},
+    )
+
+
+def solve(x, y, name=None):
+    return eager_call("solve", jnp.linalg.solve, [as_tensor(x), as_tensor(y)])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b, upper, transpose, unitriangular):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return eager_call(
+        "triangular_solve", fn, [as_tensor(x), as_tensor(y)],
+        {"upper": upper, "transpose": transpose, "unitriangular": unitriangular},
+    )
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L, upper):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return eager_call("cholesky_solve", fn, [as_tensor(x), as_tensor(y)], {"upper": upper})
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    sol, res, rank, sv = np.linalg.lstsq(np.asarray(x._data), np.asarray(y._data), rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(np.int64(rank)), Tensor(sv)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, piv.astype(np.int32) + 1  # paddle pivots are 1-based
+
+    out = eager_call("lu", fn, [as_tensor(x)], nondiff_outputs=[1])
+    if get_infos:
+        return out[0], out[1], Tensor(np.zeros((), np.int32))
+    return out[0], out[1]
+
+
+def multi_dot(tensors, name=None):
+    ts = [as_tensor(t) for t in tensors]
+    return eager_call("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), ts)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return eager_call("corrcoef", lambda a, rowvar: jnp.corrcoef(a, rowvar=rowvar), [as_tensor(x)], {"rowvar": rowvar})
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return eager_call(
+        "cov", lambda a, rowvar, ddof: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+        [as_tensor(x)], {"rowvar": rowvar, "ddof": ddof},
+    )
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, tau):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+
+        def body(i, Q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i].at[i].set(1.0))
+            H = eye - tau[..., i] * jnp.outer(v, v)
+            return Q @ H
+
+        Q = eye
+        for i in range(n):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[i].set(1.0)
+            H = eye - tau[..., i] * jnp.outer(v, v)
+            Q = Q @ H
+        return Q[..., :, :n]
+
+    return eager_call("householder_product", fn, [as_tensor(x), as_tensor(tau)])

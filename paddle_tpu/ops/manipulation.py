@@ -1,0 +1,743 @@
+"""Shape / indexing / search manipulation ops.
+
+Parity surface: reference ``python/paddle/tensor/manipulation.py``,
+``search.py``, ``logic.py`` plus the C++ kernels behind them (concat, split,
+gather/scatter, slice, transpose — ``paddle/fluid/operators/*.cc``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from ..core.dispatch import as_tensor, eager_call
+
+
+def _axes(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis) if axis is not None else None
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+    return eager_call("reshape", lambda a, shape: jnp.reshape(a, shape), [as_tensor(x)], {"shape": shape})
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def transpose(x, perm, name=None):
+    perm = tuple(int(p) for p in perm)
+    return eager_call("transpose", lambda a, perm: jnp.transpose(a, perm), [as_tensor(x)], {"perm": perm})
+
+
+def t(x, name=None):
+    x = as_tensor(x)
+    if x.ndim < 2:
+        return x
+    return eager_call("t", lambda a: jnp.swapaxes(a, -1, -2), [x])
+
+
+def concat(x, axis=0, name=None):
+    tensors = [as_tensor(t) for t in x]
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return eager_call("concat", lambda *arrs, axis: jnp.concatenate(arrs, axis=axis), tensors, {"axis": axis})
+
+
+def stack(x, axis=0, name=None):
+    tensors = [as_tensor(t) for t in x]
+    return eager_call("stack", lambda *arrs, axis: jnp.stack(arrs, axis=axis), tensors, {"axis": int(axis)})
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = as_tensor(x)
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {dim} on axis {axis} is not divisible by "
+                f"num_or_sections={num_or_sections}"
+            )
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        n_unknown = sections.count(-1)
+        if n_unknown:
+            known = builtins_sum(s for s in sections if s != -1)
+            sections = [s if s != -1 else dim - known for s in sections]
+    offsets = np.cumsum([0] + sections).tolist()
+
+    def fn(a, offsets, axis):
+        return tuple(jax.lax.slice_in_dim(a, offsets[i], offsets[i + 1], axis=axis) for i in range(len(offsets) - 1))
+
+    return eager_call("split", fn, [x], {"offsets": tuple(offsets), "axis": axis})
+
+
+import builtins
+
+builtins_sum = builtins.sum
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = as_tensor(x)
+    n = x.shape[axis]
+
+    def fn(a, axis, n):
+        return tuple(jnp.squeeze(jax.lax.slice_in_dim(a, i, i + 1, axis=axis), axis=axis) for i in range(n))
+
+    return eager_call("unbind", fn, [x], {"axis": int(axis), "n": n})
+
+
+unstack = unbind
+
+
+def squeeze(x, axis=None, name=None):
+    x = as_tensor(x)
+
+    def fn(a, axis):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        axes = tuple(a_ for a_ in axes if a.shape[a_] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return eager_call("squeeze", fn, [x], {"axis": _axes(axis)})
+
+
+def unsqueeze(x, axis, name=None):
+    x = as_tensor(x)
+    axes = _axes(axis)
+    if isinstance(axes, int):
+        axes = (axes,)
+    return eager_call("unsqueeze", lambda a, axes: jnp.expand_dims(a, axes), [x], {"axes": axes})
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = as_tensor(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+
+    def fn(a, sa, ea):
+        shape = a.shape[:sa] + (-1,) + a.shape[ea + 1 :]
+        return jnp.reshape(a, shape)
+
+    return eager_call("flatten", fn, [x], {"sa": sa, "ea": ea})
+
+
+def expand(x, shape, name=None):
+    x = as_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = tuple(int(s) for s in shape)
+    # paddle semantics: -1 means keep original dim
+    full = []
+    offset = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            full.append(x.shape[i - offset])
+        else:
+            full.append(s)
+    return eager_call("expand", lambda a, shape: jnp.broadcast_to(a, shape), [x], {"shape": tuple(full)})
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return expand(x, as_tensor(y).shape)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = jnp.broadcast_arrays(*[as_tensor(t)._data for t in inputs])
+    return [Tensor(a) for a in arrs]
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    reps = tuple(int(r.item()) if isinstance(r, Tensor) else int(r) for r in repeat_times)
+    return eager_call("tile", lambda a, reps: jnp.tile(a, reps), [as_tensor(x)], {"reps": reps})
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = as_tensor(x)
+    if isinstance(repeats, Tensor):
+        return eager_call(
+            "repeat_interleave_t",
+            lambda a, r, axis: jnp.repeat(a, r, axis=axis, total_repeat_length=int(np.asarray(repeats.numpy()).sum())),
+            [x, repeats],
+            {"axis": _axes(axis)},
+        )
+    return eager_call(
+        "repeat_interleave",
+        lambda a, repeats, axis: jnp.repeat(a, repeats, axis=axis),
+        [x],
+        {"repeats": int(repeats), "axis": _axes(axis)},
+    )
+
+
+def flip(x, axis, name=None):
+    axes = _axes(axis)
+    if isinstance(axes, int):
+        axes = (axes,)
+    return eager_call("flip", lambda a, axes: jnp.flip(a, axis=axes), [as_tensor(x)], {"axes": axes})
+
+
+def roll(x, shifts, axis=None, name=None):
+    return eager_call(
+        "roll",
+        lambda a, shifts, axis: jnp.roll(a, shifts, axis=axis),
+        [as_tensor(x)],
+        {"shifts": _axes(shifts), "axis": _axes(axis)},
+    )
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return eager_call("rot90", lambda a, k, axes: jnp.rot90(a, k=k, axes=axes), [as_tensor(x)], {"k": k, "axes": tuple(axes)})
+
+
+def cast(x, dtype):
+    from .math import cast as _cast
+
+    return _cast(x, dtype)
+
+
+# -- gather / scatter --------------------------------------------------------
+def gather(x, index, axis=0, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return eager_call("gather", lambda a, idx, axis: jnp.take(a, idx.reshape(-1), axis=axis), [x, index], {"axis": axis})
+
+
+def gather_nd(x, index, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+
+    def fn(a, idx):
+        nd = idx.shape[-1]
+        idx_t = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[idx_t]
+
+    return eager_call("gather_nd", fn, [x, index])
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = as_tensor(arr), as_tensor(indices)
+    return eager_call(
+        "take_along_axis",
+        lambda a, idx, axis: jnp.take_along_axis(a, idx, axis=axis),
+        [arr, indices],
+        {"axis": int(axis)},
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr, indices, values = as_tensor(arr), as_tensor(indices), as_tensor(values)
+
+    def fn(a, idx, v, axis, reduce):
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, idx, v, axis=axis, inplace=False)
+        mode = {"add": "add", "mul": "multiply", "multiply": "multiply"}[reduce]
+        return _scatter_reduce(a, idx, v, axis, mode)
+
+    return eager_call("put_along_axis", fn, [arr, indices, values], {"axis": int(axis), "reduce": reduce})
+
+
+def _scatter_reduce(a, idx, v, axis, mode):
+    a_m = jnp.moveaxis(a, axis, 0)
+    idx_m = jnp.moveaxis(idx, axis, 0)
+    v_m = jnp.moveaxis(v, axis, 0)
+    grid = jnp.indices(idx_m.shape[1:])
+    out = a_m
+    if mode == "add":
+        out = out.at[(idx_m,) + tuple(jnp.broadcast_to(g, idx_m.shape) for g in grid)].add(v_m)
+    else:
+        out = out.at[(idx_m,) + tuple(jnp.broadcast_to(g, idx_m.shape) for g in grid)].multiply(v_m)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = as_tensor(x), as_tensor(index), as_tensor(updates)
+
+    def fn(a, idx, upd, overwrite):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        return a.at[idx].add(upd)
+
+    return eager_call("scatter", fn, [x, index, updates], {"overwrite": overwrite})
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = as_tensor(x), as_tensor(index), as_tensor(updates)
+
+    def fn(a, idx, upd):
+        idx_t = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[idx_t].add(upd)
+
+    return eager_call("scatter_nd_add", fn, [x, index, updates])
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = as_tensor(index), as_tensor(updates)
+    return eager_call(
+        "scatter_nd",
+        lambda idx, upd, shape: jnp.zeros(shape, upd.dtype).at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd),
+        [index, updates],
+        {"shape": tuple(int(s) for s in shape)},
+    )
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    return eager_call(
+        "index_select", lambda a, idx, axis: jnp.take(a, idx, axis=axis), [x, index], {"axis": int(axis)}
+    )
+
+
+def index_sample(x, index):
+    x, index = as_tensor(x), as_tensor(index)
+    return eager_call(
+        "index_sample", lambda a, idx: jnp.take_along_axis(a, idx, axis=1), [x, index]
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = as_tensor(x), as_tensor(index), as_tensor(value)
+
+    def fn(a, idx, v, axis):
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        return jnp.moveaxis(a_m.at[idx].add(v_m), 0, axis)
+
+    return eager_call("index_add", fn, [x, index, value], {"axis": int(axis)})
+
+
+class _HashableArray:
+    """Wrap an ndarray so it can live in the jit-cache attr key."""
+
+    def __init__(self, arr):
+        self.arr = np.asarray(arr)
+
+    def __hash__(self):
+        return hash((self.arr.shape, str(self.arr.dtype), self.arr.tobytes()))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, _HashableArray)
+            and self.arr.shape == other.arr.shape
+            and np.array_equal(self.arr, other.arr)
+        )
+
+
+def masked_select(x, mask, name=None):
+    """Differentiable: mask must be concrete (dynamic output shape), but the
+    gather itself is a recorded op so gradients scatter back into x."""
+    x, mask = as_tensor(x), as_tensor(mask)
+    m = np.broadcast_to(np.asarray(mask._data), tuple(x.shape))
+    flat_idx = np.flatnonzero(m)
+
+    def fn(a, flat_idx):
+        return jnp.take(a.reshape(-1), jnp.asarray(flat_idx.arr))
+
+    return eager_call("masked_select", fn, [x], {"flat_idx": _HashableArray(flat_idx)})
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = as_tensor(x), as_tensor(mask)
+    if isinstance(value, Tensor):
+        return eager_call(
+            "masked_fill_t", lambda a, m, v: jnp.where(m, v.astype(a.dtype), a), [x, mask, value]
+        )
+    return eager_call(
+        "masked_fill", lambda a, m, value: jnp.where(m, jnp.asarray(value, a.dtype), a), [x, mask], {"value": value}
+    )
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = as_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return eager_call(
+        "where",
+        lambda c, a, b: jnp.where(c, a, b),
+        [condition, as_tensor(x), as_tensor(y)],
+    )
+
+
+def nonzero(x, as_tuple=False, name=None):
+    x = as_tensor(x)
+    nz = np.nonzero(np.asarray(x._data))  # dynamic shape → host
+    if as_tuple:
+        return tuple(Tensor(np.asarray(i, dtype=np.int64).reshape(-1, 1)) for i in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int64))
+
+
+def slice(x, axes, starts, ends, name=None):
+    x = as_tensor(x)
+    axes = [int(a) for a in axes]
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def fn(a, axes, starts, ends):
+        for ax, st, en in zip(axes, starts, ends):
+            dim = a.shape[ax]
+            st2 = max(st + dim, 0) if st < 0 else min(st, dim)
+            en2 = max(en + dim, 0) if en < 0 else min(en, dim)
+            a = jax.lax.slice_in_dim(a, st2, en2, axis=ax)
+        return a
+
+    return eager_call("slice", fn, [x], {"axes": tuple(axes), "starts": tuple(starts), "ends": tuple(ends)})
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = as_tensor(x)
+    import builtins as _b
+
+    idx = [_b.slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        st = int(st.item()) if isinstance(st, Tensor) else int(st)
+        en = int(en.item()) if isinstance(en, Tensor) else int(en)
+        sr = int(sr.item()) if isinstance(sr, Tensor) else int(sr)
+        idx[int(ax)] = _b.slice(st, en, sr)
+    return getitem(x, tuple(idx))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = as_tensor(x)
+    shape = [int(s) for s in (shape or x.shape)]
+    offsets = [int(o) for o in (offsets or [0] * x.ndim)]
+    import builtins as _b
+
+    idx = tuple(_b.slice(o, o + s) for o, s in zip(offsets, shape))
+    return getitem(x, idx)
+
+
+# -- python indexing ---------------------------------------------------------
+def _norm_index(x, item):
+    """Convert Tensors in an index expression to arrays; return hashability."""
+    if not isinstance(item, tuple):
+        item = (item,)
+    tensors = []
+    spec = []
+    for it in item:
+        if isinstance(it, Tensor):
+            if it.dtype == np.dtype("bool"):
+                spec.append(("bool_mask", np.asarray(it._data)))
+            else:
+                spec.append(("tensor", len(tensors)))
+                tensors.append(it)
+        elif isinstance(it, (list, np.ndarray)):
+            arr = np.asarray(it)
+            if arr.dtype == np.bool_:
+                spec.append(("bool_mask", arr))
+            else:
+                spec.append(("array", arr))
+        else:
+            spec.append(("static", it))
+    return spec, tensors
+
+
+def getitem(x, item):
+    x = as_tensor(x)
+    spec, tensors = _norm_index(x, item)
+
+    # Bool masks are concrete (dynamic output shape) so jnp resolves them at
+    # trace time; keeping them inside the traced fn preserves the autograd
+    # graph (reference: masked select is differentiable).
+    def fn(a, *idx_arrays, spec=None):
+        it = []
+        for k, v in spec:
+            if k == "static":
+                it.append(v)
+            elif k in ("array", "bool_mask"):
+                it.append(v)
+            else:
+                it.append(idx_arrays[v])
+        return a[tuple(it)]
+
+    return eager_call("getitem", fn, [x] + tensors, {"spec": _FrozenSpec(spec)})
+
+
+def setitem(x, item, value):
+    """In-place assignment (reference: __setitem__ via the set_value op,
+    ``paddle/fluid/operators/set_value_op.cc``).
+
+    Functional under the hood: produces a new buffer and replaces ``x._data``;
+    the autograd graph link is preserved by recording a scatter-style op.
+    """
+    spec, tensors = _norm_index(x, item)
+    scalar = value if isinstance(value, (int, float, bool)) and not isinstance(value, Tensor) else None
+    n_idx = len(tensors)
+
+    def fn(a, *rest, spec=None, scalar=None, n_idx=0):
+        it = []
+        for k, v in spec:
+            if k == "static":
+                it.append(v)
+            elif k in ("array", "bool_mask"):
+                it.append(jnp.asarray(v))
+            else:
+                it.append(rest[v])
+        if scalar is not None:
+            val = jnp.asarray(scalar, a.dtype)
+        else:
+            val = rest[n_idx].astype(a.dtype)
+        return a.at[tuple(it)].set(val)
+
+    inputs = [x] + tensors
+    if scalar is None:
+        inputs = inputs + [as_tensor(value)]
+    out = eager_call(
+        "setitem", fn, inputs, {"spec": _FrozenSpec(spec), "scalar": scalar, "n_idx": n_idx}
+    )
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    if out._grad_node is not None:
+        x.stop_gradient = False
+    return x
+
+
+class _FrozenSpec:
+    """Hashable wrapper for an index spec (may contain ndarrays/slices)."""
+
+    def __init__(self, spec):
+        self.spec = spec
+
+    def __iter__(self):
+        return iter(self.spec)
+
+    def _key(self):
+        import builtins as _b
+
+        out = []
+        for k, v in self.spec:
+            if isinstance(v, np.ndarray):
+                out.append((k, v.shape, v.tobytes()))
+            elif isinstance(v, _b.slice):
+                out.append((k, "slice", v.start, v.stop, v.step))
+            elif v is Ellipsis:
+                out.append((k, "ellipsis"))
+            else:
+                out.append((k, v))
+        return tuple(out)
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __eq__(self, other):
+        return isinstance(other, _FrozenSpec) and self._key() == other._key()
+
+
+def _freeze_spec(spec):
+    return _FrozenSpec(spec)
+
+
+# -- search / sort -----------------------------------------------------------
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = as_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def fn(a, k, axis, largest):
+        src = a if largest else -a
+        src_m = jnp.moveaxis(src, axis, -1)
+        vals, idx = jax.lax.top_k(src_m, k)
+        if not largest:
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+        # re-gather values differentiably
+        orig = jnp.take_along_axis(a, idx.astype(jnp.int32), axis=axis)
+        return orig, idx.astype(np.int64)
+
+    out = eager_call("topk", fn, [x], {"k": k, "axis": int(axis), "largest": largest}, nondiff_outputs=[1])
+    return out[0], out[1]
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    x = as_tensor(x)
+
+    def fn(a, axis, descending):
+        idx = jnp.argsort(a, axis=axis, descending=descending)
+        return jnp.take_along_axis(a, idx, axis=axis)
+
+    return eager_call("sort", fn, [x], {"axis": int(axis), "descending": descending})
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    x = as_tensor(x)
+    return eager_call(
+        "argsort",
+        lambda a, axis, descending: jnp.argsort(a, axis=axis, descending=descending).astype(np.int64),
+        [x],
+        {"axis": int(axis), "descending": descending},
+        differentiable=False,
+    )
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    ss, v = as_tensor(sorted_sequence), as_tensor(values)
+
+    def fn(a, b, right, out_int32):
+        side = "right" if right else "left"
+        if a.ndim == 1:
+            r = jnp.searchsorted(a, b, side=side)
+        else:
+            r = jax.vmap(lambda row, val: jnp.searchsorted(row, val, side=side))(
+                a.reshape(-1, a.shape[-1]), b.reshape(-1, b.shape[-1])
+            ).reshape(b.shape)
+        return r.astype(np.int32 if out_int32 else np.int64)
+
+    return eager_call("searchsorted", fn, [ss, v], {"right": right, "out_int32": out_int32}, differentiable=False)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    res = np.unique(
+        np.asarray(x._data), return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis
+    )
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(res)
+    out = [Tensor(r if i == 0 else r.astype(np.int64)) for i, r in enumerate(res)]
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = np.asarray(as_tensor(x)._data)
+    if axis is None:
+        x = x.reshape(-1)
+    mask = np.empty(x.shape[0], dtype=bool)
+    mask[0] = True
+    mask[1:] = np.any(x[1:] != x[:-1], axis=tuple(range(1, x.ndim))) if x.ndim > 1 else x[1:] != x[:-1]
+    out = Tensor(x[mask])
+    rets = [out]
+    if return_inverse:
+        rets.append(Tensor(np.cumsum(mask) - 1))
+    if return_counts:
+        idx = np.flatnonzero(mask)
+        counts = np.diff(np.append(idx, x.shape[0]))
+        rets.append(Tensor(counts.astype(np.int64)))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = as_tensor(x)
+    w = np.asarray(as_tensor(weights)._data) if weights is not None else None
+    return Tensor(np.bincount(np.asarray(x._data), weights=w, minlength=minlength))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    x = np.asarray(as_tensor(input)._data)
+    if min == 0 and max == 0:
+        min, max = float(x.min()), float(x.max())
+    hist, _ = np.histogram(x, bins=bins, range=(min, max))
+    return Tensor(hist.astype(np.int64))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    def fn(a, pad, mode, value, data_format):
+        nd = a.ndim
+        if len(pad) == nd * 2:
+            width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle nn.functional.pad convention: pad applies to last dims
+            # (pairs, reversed for NCHW spatial dims)
+            n_spatial = len(pad) // 2
+            width = [(0, 0)] * (nd - n_spatial)
+            if data_format.endswith("C") and nd - 2 == n_spatial:  # NHWC-style
+                width = [(0, 0)] + [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)] + [(0, 0)]
+            else:
+                width += [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode=jmode, constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    return eager_call(
+        "pad", fn, [x], {"pad": tuple(pad), "mode": mode, "value": value, "data_format": data_format}
+    )
+
+
+def atleast_1d(*inputs):
+    outs = [Tensor(jnp.atleast_1d(as_tensor(t)._data)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs):
+    outs = [Tensor(jnp.atleast_2d(as_tensor(t)._data)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs):
+    outs = [Tensor(jnp.atleast_3d(as_tensor(t)._data)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def as_real(x, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.stack([jnp.real(x._data), jnp.imag(x._data)], axis=-1))
+
+
+def as_complex(x, name=None):
+    x = as_tensor(x)
+    return eager_call("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), [x])
+
+
+def real(x, name=None):
+    return eager_call("real", jnp.real, [as_tensor(x)])
+
+
+def imag(x, name=None):
+    return eager_call("imag", jnp.imag, [as_tensor(x)])
+
+
+def conj(x, name=None):
+    return eager_call("conj", jnp.conj, [as_tensor(x)])
+
+
+def moveaxis(x, source, destination, name=None):
+    return eager_call(
+        "moveaxis",
+        lambda a, s, d: jnp.moveaxis(a, s, d),
+        [as_tensor(x)],
+        {"s": _axes(source), "d": _axes(destination)},
+    )
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return eager_call(
+        "swapaxes", lambda a, a0, a1: jnp.swapaxes(a, a0, a1), [as_tensor(x)], {"a0": int(axis0), "a1": int(axis1)}
+    )
+
+
+transpose_ = swapaxes

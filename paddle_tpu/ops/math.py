@@ -1,0 +1,528 @@
+"""Math / elementwise / reduction ops.
+
+Parity surface: reference ``python/paddle/tensor/math.py`` + the C++/CUDA
+elementwise kernels (``paddle/fluid/operators/elementwise/``), reduce ops
+(``reduce_ops/``) and activation kernels — all jnp/XLA here, fused by the
+compiler instead of hand-written grad kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from ..core.dispatch import as_tensor, eager_call
+
+
+def _scalarize(v):
+    """True if v should be closed over as a python scalar (weak-typed)."""
+    return isinstance(v, (int, float, bool)) and not isinstance(v, Tensor)
+
+
+def _binary(op_name, jfn):
+    def op(x, y, name=None):
+        if _scalarize(y) and isinstance(x, Tensor):
+            return eager_call(op_name, lambda a, s: jfn(a, s), [x], {"s": y})
+        if _scalarize(x) and isinstance(y, Tensor):
+            return eager_call(op_name, lambda b, s: jfn(s, b), [y], {"s": x})
+        return eager_call(op_name, jfn, [as_tensor(x), as_tensor(y)])
+
+    op.__name__ = op_name
+    return op
+
+
+def _rbinary(op_name, jfn):
+    def op(y, x, name=None):  # reflected
+        if _scalarize(x):
+            return eager_call(op_name, lambda b, s: jfn(s, b), [as_tensor(y)], {"s": x})
+        return eager_call(op_name, jfn, [as_tensor(x), as_tensor(y)])
+
+    return op
+
+
+def _unary(op_name, jfn, differentiable=True):
+    def op(x, name=None):
+        return eager_call(op_name, jfn, [as_tensor(x)], differentiable=differentiable)
+
+    op.__name__ = op_name
+    return op
+
+
+# -- elementwise binary ------------------------------------------------------
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+remainder = _binary("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+heaviside = _binary("heaviside", jnp.heaviside)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+ldexp = _binary("ldexp", jnp.ldexp)
+
+
+def pow(x, y, name=None):
+    if _scalarize(y):
+        return eager_call("pow", lambda a, s: jnp.power(a, s), [as_tensor(x)], {"s": y})
+    return eager_call("pow", jnp.power, [as_tensor(x), as_tensor(y)])
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = as_tensor(x)
+
+    def fn(a, scale, bias, bias_after_scale):
+        if bias_after_scale:
+            return a * scale + bias
+        return (a + bias) * scale
+
+    out = eager_call("scale", fn, [x], {"scale": float(scale), "bias": float(bias), "bias_after_scale": bias_after_scale})
+    return out
+
+
+# -- elementwise unary -------------------------------------------------------
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", lambda a: jax.lax.rsqrt(a))
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+sign = _unary("sign", jnp.sign)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+square = _unary("square", jnp.square)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+i0 = _unary("i0", jax.scipy.special.i0)
+i1 = _unary("i1", jax.scipy.special.i1)
+isnan = _unary("isnan", jnp.isnan, differentiable=False)
+isinf = _unary("isinf", jnp.isinf, differentiable=False)
+isfinite = _unary("isfinite", jnp.isfinite, differentiable=False)
+logical_not = _unary("logical_not", jnp.logical_not, differentiable=False)
+bitwise_not = _unary("bitwise_not", jnp.bitwise_not, differentiable=False)
+
+
+def clip(x, min=None, max=None, name=None):
+    x = as_tensor(x)
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return eager_call("clip", lambda a, mn, mx: jnp.clip(a, mn, mx), [x], {"mn": mn, "mx": mx})
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return eager_call("lerp", lambda a, b, w: a + w * (b - a), [as_tensor(x), as_tensor(y), weight])
+    return eager_call("lerp", lambda a, b, w: a + w * (b - a), [as_tensor(x), as_tensor(y)], {"w": weight})
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return eager_call(
+        "nan_to_num",
+        lambda a, nan, posinf, neginf: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        [as_tensor(x)],
+        {"nan": nan, "posinf": posinf, "neginf": neginf},
+    )
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return eager_call(
+        "stanh", lambda a, sa, sb: sb * jnp.tanh(sa * a), [as_tensor(x)], {"sa": scale_a, "sb": scale_b}
+    )
+
+
+# -- comparison / logical (non-differentiable) -------------------------------
+def _cmp(op_name, jfn):
+    def op(x, y, name=None):
+        if _scalarize(y):
+            return eager_call(op_name, lambda a, s: jfn(a, s), [as_tensor(x)], {"s": y}, differentiable=False)
+        return eager_call(op_name, jfn, [as_tensor(x), as_tensor(y)], differentiable=False)
+
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+def equal_all(x, y, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.array_equal(x._data, y._data))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.allclose(x._data, y._data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return eager_call(
+        "isclose",
+        lambda a, b, rtol, atol, equal_nan: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        [as_tensor(x), as_tensor(y)],
+        {"rtol": rtol, "atol": atol, "equal_nan": equal_nan},
+        differentiable=False,
+    )
+
+
+# -- reductions --------------------------------------------------------------
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.tolist())
+    return int(axis)
+
+
+def _reduce(op_name, jfn, differentiable=True):
+    def op(x, axis=None, keepdim=False, name=None):
+        x = as_tensor(x)
+        return eager_call(
+            op_name,
+            lambda a, axis, keepdim: jfn(a, axis=axis, keepdims=keepdim),
+            [x],
+            {"axis": _norm_axis(axis), "keepdim": keepdim},
+            differentiable=differentiable,
+        )
+
+    op.__name__ = op_name
+    return op
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else None
+    if dt is None and (dtypes.is_integer(x.dtype) or x.dtype == np.dtype("bool")):
+        dt = np.dtype("int64")
+
+    def fn(a, axis, keepdim, dt):
+        return jnp.sum(a, axis=axis, keepdims=keepdim, dtype=dt)
+
+    return eager_call(
+        "sum", fn, [x], {"axis": _norm_axis(axis), "keepdim": keepdim, "dt": dt}
+    )
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce("mean", jnp.mean)(x, axis, keepdim)
+
+
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+prod = _reduce("prod", jnp.prod)
+amax = max
+amin = min
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _reduce("all", jnp.all, differentiable=False)(x, axis, keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _reduce("any", jnp.any, differentiable=False)(x, axis, keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = as_tensor(x)
+    return eager_call(
+        "std",
+        lambda a, axis, ddof, keepdim: jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdim),
+        [x],
+        {"axis": _norm_axis(axis), "ddof": 1 if unbiased else 0, "keepdim": keepdim},
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = as_tensor(x)
+    return eager_call(
+        "var",
+        lambda a, axis, ddof, keepdim: jnp.var(a, axis=axis, ddof=ddof, keepdims=keepdim),
+        [x],
+        {"axis": _norm_axis(axis), "ddof": 1 if unbiased else 0, "keepdim": keepdim},
+    )
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return eager_call(
+        "median",
+        lambda a, axis, keepdim: jnp.median(a, axis=axis, keepdims=keepdim),
+        [as_tensor(x)],
+        {"axis": _norm_axis(axis), "keepdim": keepdim},
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return eager_call(
+        "quantile",
+        lambda a, q, axis, keepdim: jnp.quantile(a, jnp.asarray(q), axis=axis, keepdims=keepdim),
+        [as_tensor(x)],
+        {"q": q, "axis": _norm_axis(axis), "keepdim": keepdim},
+    )
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return eager_call(
+        "nanmean",
+        lambda a, axis, keepdim: jnp.nanmean(a, axis=axis, keepdims=keepdim),
+        [as_tensor(x)],
+        {"axis": _norm_axis(axis), "keepdim": keepdim},
+    )
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return eager_call(
+        "nansum",
+        lambda a, axis, keepdim: jnp.nansum(a, axis=axis, keepdims=keepdim),
+        [as_tensor(x)],
+        {"axis": _norm_axis(axis), "keepdim": keepdim},
+    )
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return eager_call(
+        "logsumexp",
+        lambda a, axis, keepdim: jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdim),
+        [as_tensor(x)],
+        {"axis": _norm_axis(axis), "keepdim": keepdim},
+    )
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+
+    def fn(a, axis, keepdim):
+        r = jnp.argmax(a, axis=axis, keepdims=keepdim if axis is not None else False)
+        return r.astype(np.int64)
+
+    return eager_call("argmax", fn, [x], {"axis": _norm_axis(axis), "keepdim": keepdim}, differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+
+    def fn(a, axis, keepdim):
+        r = jnp.argmin(a, axis=axis, keepdims=keepdim if axis is not None else False)
+        return r.astype(np.int64)
+
+    return eager_call("argmin", fn, [x], {"axis": _norm_axis(axis), "keepdim": keepdim}, differentiable=False)
+
+
+# -- scans -------------------------------------------------------------------
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = as_tensor(x)
+
+    def fn(a, axis):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1))
+        return jnp.cumsum(a, axis=axis)
+
+    return eager_call("cumsum", fn, [x], {"axis": _norm_axis(axis)})
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = as_tensor(x)
+    return eager_call("cumprod", lambda a, axis: jnp.cumprod(a, axis=axis), [x], {"axis": _norm_axis(dim)})
+
+
+def _cum_minmax(x, axis, op):
+    """Cumulative max/min with per-position argmax/argmin indices via one
+    associative scan over (value, index) pairs — XLA log-depth scan."""
+    x = as_tensor(x)
+    flat = axis is None
+
+    def fn(a, axis, flat, op):
+        if flat:
+            a = a.reshape(-1)
+            axis = 0
+        n = a.shape[axis]
+        shape = [1] * a.ndim
+        shape[axis] = n
+        idx = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int64).reshape(shape), a.shape)
+
+        def combine(c1, c2):
+            v1, i1 = c1
+            v2, i2 = c2
+            take2 = (v2 > v1) if op == "max" else (v2 < v1)
+            return jnp.where(take2, v2, v1), jnp.where(take2, i2, i1)
+
+        vals, inds = jax.lax.associative_scan(combine, (a, idx), axis=axis)
+        return vals, inds
+
+    out = eager_call(
+        f"cum{op}", fn, [x],
+        {"axis": _norm_axis(axis) if not flat else None, "flat": flat, "op": op},
+        nondiff_outputs=[1],
+    )
+    return out[0], out[1]
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_minmax(x, axis, "max")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_minmax(x, axis, "min")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = as_tensor(x)
+
+    def fn(a, axis):
+        if axis is None:
+            a = a.reshape(-1)
+            axis = 0
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=axis)
+
+    return eager_call("logcumsumexp", fn, [x], {"axis": _norm_axis(axis)})
+
+
+# -- matmul family -----------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Reference: matmul_v2 (paddle/fluid/operators/matmul_v2_op.cc) — lowered
+    straight to the MXU via jnp.matmul/dot_general."""
+
+    def fn(a, b, transpose_x, transpose_y):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return eager_call(
+        "matmul", fn, [as_tensor(x), as_tensor(y)],
+        {"transpose_x": transpose_x, "transpose_y": transpose_y},
+    )
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    def fn(a, b):
+        return jnp.sum(a * b, axis=-1)
+
+    return eager_call("dot", fn, [as_tensor(x), as_tensor(y)])
+
+
+def inner(x, y, name=None):
+    return eager_call("inner", jnp.inner, [as_tensor(x), as_tensor(y)])
+
+
+def outer(x, y, name=None):
+    return eager_call("outer", lambda a, b: jnp.outer(a, b), [as_tensor(x), as_tensor(y)])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return eager_call(
+        "addmm",
+        lambda i, a, b, beta, alpha: beta * i + alpha * (a @ b),
+        [as_tensor(input), as_tensor(x), as_tensor(y)],
+        {"beta": beta, "alpha": alpha},
+    )
+
+
+def bmm(x, y, name=None):
+    return eager_call("bmm", jnp.matmul, [as_tensor(x), as_tensor(y)])
+
+
+def kron(x, y, name=None):
+    return eager_call("kron", jnp.kron, [as_tensor(x), as_tensor(y)])
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return eager_call(
+        "trace",
+        lambda a, offset, axis1, axis2: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+        [as_tensor(x)],
+        {"offset": offset, "axis1": axis1, "axis2": axis2},
+    )
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return eager_call(
+        "diagonal",
+        lambda a, offset, axis1, axis2: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        [as_tensor(x)],
+        {"offset": offset, "axis1": axis1, "axis2": axis2},
+    )
+
+
+def mv(x, vec, name=None):
+    return eager_call("mv", jnp.matmul, [as_tensor(x), as_tensor(vec)])
+
+
+def dist(x, y, p=2, name=None):
+    return eager_call(
+        "dist",
+        lambda a, b, p: jnp.linalg.norm((a - b).reshape(-1), ord=p),
+        [as_tensor(x), as_tensor(y)],
+        {"p": float(p)},
+    )
+
+
+# -- misc --------------------------------------------------------------------
+def cast(x, dtype):
+    x = as_tensor(x)
+    dt = dtypes.convert_dtype(dtype)
+    src_float = dtypes.is_floating_point(x.dtype) or dtypes.is_complex(x.dtype)
+    return eager_call(
+        "cast", lambda a, dt: a.astype(dt), [x], {"dt": dt},
+        differentiable=src_float and dtypes.is_floating_point(dt),
+    )
+
+
+def increment(x, value=1.0, name=None):
+    x = as_tensor(x)
+    x._set_data(x._data + value)
+    return x
+
+
+def accuracy_tensor(pred, label):  # helper used by metric
+    pred, label = as_tensor(pred), as_tensor(label)
+    correct = jnp.equal(jnp.argmax(pred._data, axis=-1), label._data.reshape(-1))
+    return Tensor(jnp.mean(correct.astype(jnp.float32)))

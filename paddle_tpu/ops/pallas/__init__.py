@@ -1,0 +1,2 @@
+"""Pallas TPU kernels (hot-op fast paths). Imported lazily; each kernel file
+guards on TPU availability and falls back to the XLA formulation."""

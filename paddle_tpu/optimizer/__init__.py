@@ -1,0 +1,365 @@
+"""Optimizers.
+
+Parity: reference ``python/paddle/optimizer/`` (Adam/AdamW/SGD/Momentum/LAMB/
+RMSProp/Adagrad/Adadelta/Adamax + lr schedulers) whose update rules are C++/
+CUDA kernels (``paddle/fluid/operators/optimizers/``). Here each rule is one
+pure XLA function over (param, grad, state) — usable both eagerly (jitted
+per-param) and inside a fully-fused compiled train step
+(paddle_tpu.jit.CompiledTrainStep), where forward+backward+update become a
+single executable.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import no_grad
+from ..core.tensor import Tensor
+from . import lr as lr_mod
+from .lr import LRScheduler
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None, **kwargs):
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._learning_rate = learning_rate
+        if isinstance(weight_decay, (float, int)):
+            self.regularization = L2Decay(float(weight_decay))
+        else:
+            self.regularization = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[int, dict] = {}
+        self._step_count = 0
+        self._jitted_rule = None
+
+    # -- lr ---------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- pure rule API (implemented by subclasses) -------------------------
+    def _init_accums(self, p_arr) -> dict:
+        return {}
+
+    def _rule(self, p, g, st: dict, lr, t, wd_scale=1.0):
+        """Pure: (param, grad, state, lr, step, wd on/off) → (new_p, new_state)."""
+        raise NotImplementedError
+
+    def _wd_on(self, p) -> float:
+        """Per-parameter decay gate (AdamW apply_decay_param_fun parity)."""
+        return 1.0
+
+    # -- state ------------------------------------------------------------
+    def _state(self, p) -> dict:
+        return self._accumulators.setdefault(id(p), {})
+
+    def state_dict(self):
+        out = {}
+        for p in self._parameter_list or []:
+            st = self._accumulators.get(id(p))
+            if st:
+                for k, v in st.items():
+                    out[f"{p.name}.{k}"] = v if isinstance(v, Tensor) else Tensor(v)
+        out["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("@step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for p in self._parameter_list or []:
+            st = self._state(p)
+            prefix = f"{p.name}."
+            for k, v in state_dict.items():
+                if isinstance(k, str) and k.startswith(prefix):
+                    st[k[len(prefix):]] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+    load_state_dict = set_state_dict
+
+    # -- eager step --------------------------------------------------------
+    def _collect(self):
+        params = self._parameter_list or []
+        pg = [(p, p.grad) for p in params if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        return pg
+
+    def _regularize_arr(self, p_arr, g):
+        if isinstance(self.regularization, L2Decay) and self.regularization.coeff:
+            return g + self.regularization.coeff * p_arr
+        if isinstance(self.regularization, L1Decay) and self.regularization.coeff:
+            return g + self.regularization.coeff * jnp.sign(p_arr)
+        return g
+
+    @no_grad()
+    def step(self):
+        self._step_count += 1
+        lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
+        t = jnp.asarray(float(self._step_count), dtype=jnp.float32)
+        if self._jitted_rule is None:
+            def full_rule(p, g, st, lr, t, wd_scale):
+                g = self._regularize_arr(p, g)
+                return self._rule(p, g, st, lr, t, wd_scale)
+
+            self._jitted_rule = jax.jit(full_rule)
+        for p, grad in self._collect():
+            g = grad._data if isinstance(grad, Tensor) else grad
+            if g.dtype != p._data.dtype:
+                g = g.astype(p._data.dtype)
+            st = self._state(p)
+            if not st:
+                st.update(self._init_accums(p._data))
+            p_lr = lr * p.optimize_attr.get("learning_rate", 1.0) if hasattr(p, "optimize_attr") else lr
+            new_p, new_st = self._jitted_rule(p._data, g, st, p_lr, t, self._wd_on(p))
+            st.update(new_st)
+            p._set_data(new_p)
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- functional (fused-train-step) API ---------------------------------
+    def _functional_state(self, params):
+        accums = []
+        for p in params:
+            st = self._state(p)
+            if not st:
+                st.update(self._init_accums(p._data))
+            accums.append(dict(st))
+        return {"t": jnp.asarray(float(self._step_count + 1), jnp.float32), "accums": accums}
+
+    def _functional_update(self, param_arrays, grads, state, lr, params=None):
+        """Pure; traceable inside jit/pjit. ``params`` is static metadata."""
+        t = state["t"]
+        new_params, new_accums = [], []
+        for i, (p, g, st) in enumerate(zip(param_arrays, grads, state["accums"])):
+            if g is None:
+                new_params.append(p)
+                new_accums.append(st)
+                continue
+            g = g.astype(p.dtype) if g.dtype != p.dtype else g
+            g = self._regularize_arr(p, g)
+            wd = self._wd_on(params[i]) if params is not None else 1.0
+            plr = lr
+            if params is not None and hasattr(params[i], "optimize_attr"):
+                plr = lr * params[i].optimize_attr.get("learning_rate", 1.0)
+            new_p, new_st = self._rule(p, g, st, plr, t, wd)
+            new_params.append(new_p)
+            new_accums.append(new_st)
+        return new_params, {"t": t + 1.0, "accums": new_accums}
+
+    def _functional_restore(self, params, state):
+        for p, st in zip(params, state["accums"]):
+            self._accumulators[id(p)] = dict(st)
+
+
+class SGD(Optimizer):
+    def _rule(self, p, g, st, lr, t, wd_scale=1.0):
+        return p - lr.astype(p.dtype) * g, st
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_accums(self, p_arr):
+        return {"velocity": jnp.zeros_like(p_arr)}
+
+    def _rule(self, p, g, st, lr, t, wd_scale=1.0):
+        v = self._momentum * st["velocity"] + g
+        if self._use_nesterov:
+            new_p = p - (g + self._momentum * v) * lr.astype(p.dtype)
+        else:
+            new_p = p - lr.astype(p.dtype) * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = float(beta1.item()) if isinstance(beta1, Tensor) else beta1
+        self._beta2 = float(beta2.item()) if isinstance(beta2, Tensor) else beta2
+        self._epsilon = epsilon
+
+    def _init_accums(self, p_arr):
+        return {"moment1": jnp.zeros_like(p_arr), "moment2": jnp.zeros_like(p_arr)}
+
+    def _rule(self, p, g, st, lr, t, wd_scale=1.0):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * st["moment1"] + (1 - b1) * g
+        v = b2 * st["moment2"] + (1 - b2) * jnp.square(g)
+        bc1 = 1 - jnp.power(b1, t)
+        bc2 = 1 - jnp.power(b2, t)
+        lr_t = (lr * jnp.sqrt(bc2) / bc1).astype(p.dtype)
+        new_p = p - lr_t * m / (jnp.sqrt(v) + eps)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference adamw_op.cc: decay applied to param
+    before the Adam update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, name=name)
+        self._wd = weight_decay.coeff if isinstance(weight_decay, (L1Decay, L2Decay)) else float(weight_decay)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _wd_on(self, p):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return 1.0
+
+    def _rule(self, p, g, st, lr, t, wd_scale=1.0):
+        p = p * (1 - lr.astype(p.dtype) * self._wd * wd_scale)
+        return super()._rule(p, g, st, lr, t)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_accums(self, p_arr):
+        return {"moment": jnp.zeros_like(p_arr), "inf_norm": jnp.zeros_like(p_arr)}
+
+    def _rule(self, p, g, st, lr, t, wd_scale=1.0):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * st["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * st["inf_norm"], jnp.abs(g))
+        lr_t = (lr / (1 - jnp.power(b1, t))).astype(p.dtype)
+        return p - lr_t * m / (u + eps), {"moment": m, "inf_norm": u}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _init_accums(self, p_arr):
+        return {
+            "mean_square": jnp.zeros_like(p_arr),
+            "momentum": jnp.zeros_like(p_arr),
+            "mean_grad": jnp.zeros_like(p_arr),
+        }
+
+    def _rule(self, p, g, st, lr, t, wd_scale=1.0):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * st["mean_square"] + (1 - rho) * jnp.square(g)
+        mg = rho * st["mean_grad"] + (1 - rho) * g if self._centered else st["mean_grad"]
+        denom = ms - jnp.square(mg) if self._centered else ms
+        mom = self._momentum * st["momentum"] + lr.astype(p.dtype) * g / jnp.sqrt(denom + eps)
+        return p - mom, {"mean_square": ms, "momentum": mom, "mean_grad": mg}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None, weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_accums(self, p_arr):
+        return {"moment": jnp.full_like(p_arr, self._init_acc)}
+
+    def _rule(self, p, g, st, lr, t, wd_scale=1.0):
+        m = st["moment"] + jnp.square(g)
+        return p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_accums(self, p_arr):
+        return {"avg_squared_grad": jnp.zeros_like(p_arr), "avg_squared_update": jnp.zeros_like(p_arr)}
+
+    def _rule(self, p, g, st, lr, t, wd_scale=1.0):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * st["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        update = jnp.sqrt(st["avg_squared_update"] + eps) / jnp.sqrt(asg + eps) * g
+        asu = rho * st["avg_squared_update"] + (1 - rho) * jnp.square(update)
+        return p - lr.astype(p.dtype) * update, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _wd_on(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return 1.0
+
+    def _init_accums(self, p_arr):
+        return {"moment1": jnp.zeros_like(p_arr), "moment2": jnp.zeros_like(p_arr)}
+
+    def _rule(self, p, g, st, lr, t, wd_scale=1.0):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * st["moment1"] + (1 - b1) * g
+        v = b2 * st["moment2"] + (1 - b2) * jnp.square(g)
+        m_hat = m / (1 - jnp.power(b1, t))
+        v_hat = v / (1 - jnp.power(b2, t))
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + self._wd * wd_scale * p
+        w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0).astype(p.dtype)
+        return p - lr.astype(p.dtype) * trust * r, {"moment1": m, "moment2": v}
+
+
+class LarsMomentum(Optimizer):
+    """LARS (reference lars_momentum_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+
+    def _init_accums(self, p_arr):
+        return {"velocity": jnp.zeros_like(p_arr)}
+
+    def _rule(self, p, g, st, lr, t, wd_scale=1.0):
+        w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+        g_norm = jnp.linalg.norm(g.astype(jnp.float32))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + self._lars_wd * w_norm + 1e-12),
+            1.0,
+        )
+        g = g + self._lars_wd * p
+        v = self._momentum * st["velocity"] + (lr * local_lr).astype(p.dtype) * g
+        return p - v, {"velocity": v}

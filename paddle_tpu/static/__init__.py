@@ -1,0 +1,68 @@
+"""paddle.static facade.
+
+Parity: reference ``python/paddle/static/__init__.py`` — the curated
+static-graph API. TPU-native reinterpretation: a "Program" is a captured,
+compiled XLA computation (see paddle_tpu/jit); Executor.run compiles+runs it.
+The reference's Program/Scope/feed-fetch machinery
+(``python/paddle/fluid/framework.py``, ``executor.py:1093``) collapses into
+jit tracing, so these entry points adapt the same user workflow onto it.
+"""
+from __future__ import annotations
+
+from .input import InputSpec  # noqa: F401
+from .. import jit as _jit
+from ..core.tensor import Tensor
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, program=None, **kwargs):
+    """Maps to jit.save of the traced function (reference static/io.py)."""
+    raise NotImplementedError(
+        "static.save_inference_model: trace with paddle_tpu.jit.to_static and "
+        "use paddle_tpu.jit.save (static program capture IS jit capture here)"
+    )
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    layer = _jit.load(path_prefix)
+    return layer
+
+
+class Executor:
+    """Compile-and-run adapter (reference Executor.run executor.py:1093)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            args = [Tensor(v) for v in (feed or {}).values()]
+            out = program(*args)
+            return [o.numpy() for o in (out if isinstance(out, (list, tuple)) else [out])]
+        raise NotImplementedError("pass a traced callable as `program`")
+
+
+def default_main_program():
+    return None
+
+
+def default_startup_program():
+    return None
+
+
+class program_guard:
+    def __init__(self, *a, **k):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+# gradient clip re-exports for parity
+from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401,E402

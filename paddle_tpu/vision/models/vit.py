@@ -1,0 +1,61 @@
+"""Vision Transformer — ViT-B/16, ViT-L/16 (BASELINE inference config).
+
+The reference era ships ViT via PaddleClas; included here as a first-class
+model for the ViT-L inference benchmark (BASELINE.md). Patch embedding is one
+strided conv (MXU-friendly); encoder uses the fused attention functional.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+from ...ops.manipulation import concat
+
+
+class PatchEmbed(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = nn.Conv2D(in_chans, embed_dim, kernel_size=patch_size, stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)  # (B, E, H/P, W/P)
+        x = x.flatten(2).transpose([0, 2, 1])  # (B, N, E)
+        return x
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(
+        self, img_size=224, patch_size=16, in_chans=3, num_classes=1000,
+        embed_dim=768, depth=12, num_heads=12, mlp_ratio=4.0, dropout=0.0,
+    ):
+        super().__init__()
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans, embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter([1, 1, embed_dim], default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        self.pos_embed = self.create_parameter([1, n + 1, embed_dim], default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        self.pos_drop = nn.Dropout(dropout)
+        enc_layer = nn.TransformerEncoderLayer(
+            embed_dim, num_heads, int(embed_dim * mlp_ratio), dropout=dropout,
+            activation="gelu", normalize_before=True,
+        )
+        self.encoder = nn.TransformerEncoder(enc_layer, depth, norm=nn.LayerNorm(embed_dim))
+        self.head = nn.Linear(embed_dim, num_classes) if num_classes > 0 else nn.Identity()
+
+    def forward(self, x):
+        x = self.patch_embed(x)
+        B = x.shape[0]
+        cls = self.cls_token.expand([B, 1, self.cls_token.shape[2]])
+        x = concat([cls, x], axis=1)
+        x = self.pos_drop(x + self.pos_embed)
+        x = self.encoder(x)
+        return self.head(x[:, 0])
+
+
+def vit_b_16(num_classes=1000, **kwargs):
+    return VisionTransformer(embed_dim=768, depth=12, num_heads=12, num_classes=num_classes, **kwargs)
+
+
+def vit_l_16(num_classes=1000, **kwargs):
+    return VisionTransformer(embed_dim=1024, depth=24, num_heads=16, num_classes=num_classes, **kwargs)

@@ -1,0 +1,147 @@
+"""Vision ops (reference python/paddle/vision/ops.py + detection ops in
+paddle/fluid/operators/detection/). Host-side where shapes are dynamic (NMS),
+XLA where static (roi_align, box coding, deform conv via gather)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import as_tensor, eager_call
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None, top_k=None):
+    """Reference: detection/nms ops — dynamic output ⇒ host implementation."""
+    b = np.asarray(as_tensor(boxes)._data, dtype=np.float64)
+    s = np.asarray(as_tensor(scores)._data) if scores is not None else np.arange(len(b))[::-1]
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[i] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size", box_normalized=True, axis=0):
+    pb = as_tensor(prior_box)
+    tb = as_tensor(target_box)
+    pbv = as_tensor(prior_box_var) if prior_box_var is not None else None
+
+    def fn(pb, tb, *rest, code_type="encode_center_size"):
+        pw = pb[:, 2] - pb[:, 0]
+        ph = pb[:, 3] - pb[:, 1]
+        px = pb[:, 0] + pw / 2
+        py = pb[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0]
+            th = tb[:, 3] - tb[:, 1]
+            tx = tb[:, 0] + tw / 2
+            ty = tb[:, 1] + th / 2
+            out = jnp.stack(
+                [(tx - px) / pw, (ty - py) / ph, jnp.log(tw / pw), jnp.log(th / ph)], axis=-1
+            )
+        else:
+            dx, dy, dw, dh = tb[..., 0], tb[..., 1], tb[..., 2], tb[..., 3]
+            cx = dx * pw + px
+            cy = dy * ph + py
+            w = jnp.exp(dw) * pw
+            h = jnp.exp(dh) * ph
+            out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+        if rest:
+            out = out / rest[0] if code_type == "encode_center_size" else out
+        return out
+
+    args = [pb, tb] + ([pbv] if pbv is not None else [])
+    return eager_call("box_coder", fn, args, {"code_type": code_type})
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1, aligned=True, name=None):
+    x, boxes = as_tensor(x), as_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+
+    def fn(feat, rois, output_size, spatial_scale, aligned):
+        oh, ow = output_size
+        offset = 0.5 if aligned else 0.0
+
+        def one_roi(roi):
+            x1, y1, x2, y2 = roi * spatial_scale - offset
+            ys = y1 + (jnp.arange(oh) + 0.5) * (y2 - y1) / oh
+            xs = x1 + (jnp.arange(ow) + 0.5) * (x2 - x1) / ow
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            coords = jnp.stack([gy.reshape(-1), gx.reshape(-1)])
+
+            def sample_channel(ch):
+                return jax.scipy.ndimage.map_coordinates(ch, coords, order=1, mode="constant").reshape(oh, ow)
+
+            return jax.vmap(sample_channel)(feat[0])
+
+        return jax.vmap(one_roi)(rois)
+
+    return eager_call(
+        "roi_align", fn, [x, boxes],
+        {"output_size": tuple(output_size), "spatial_scale": spatial_scale, "aligned": aligned},
+    )
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    x, img_size = as_tensor(x), as_tensor(img_size)
+    anchors = list(anchors)
+    na = len(anchors) // 2
+
+    def fn(x, img_size, anchors=None, class_num=0, conf_thresh=0.0, downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+        n, c, h, w = x.shape
+        an = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+        na = an.shape[0]
+        x = x.reshape(n, na, 5 + class_num, h, w)
+        gx, gy = jnp.meshgrid(jnp.arange(w), jnp.arange(h), indexing="xy")
+        bx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx) / w
+        by = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy) / h
+        bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / (downsample_ratio * w)
+        bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / (downsample_ratio * h)
+        conf = jax.nn.sigmoid(x[:, :, 4])
+        probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+        img_h = img_size[:, 0].reshape(n, 1, 1, 1).astype(jnp.float32)
+        img_w = img_size[:, 1].reshape(n, 1, 1, 1).astype(jnp.float32)
+        x1 = (bx - bw / 2) * img_w
+        y1 = (by - bh / 2) * img_h
+        x2 = (bx + bw / 2) * img_w
+        y2 = (by + bh / 2) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, img_w - 1)
+            y1 = jnp.clip(y1, 0, img_h - 1)
+            x2 = jnp.clip(x2, 0, img_w - 1)
+            y2 = jnp.clip(y2, 0, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+        mask = conf.reshape(n, -1, 1) > conf_thresh
+        scores = jnp.where(mask, scores, 0.0)
+        return boxes, scores
+
+    out = eager_call(
+        "yolo_box", fn, [x, img_size],
+        {"anchors": tuple(anchors), "class_num": class_num, "conf_thresh": conf_thresh,
+         "downsample_ratio": downsample_ratio, "clip_bbox": clip_bbox, "scale_x_y": scale_x_y},
+        differentiable=False,
+    )
+    return out[0], out[1]
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("DeformConv2D: planned (gather-based Pallas kernel)")

@@ -1,0 +1,31 @@
+"""Test harness config.
+
+SURVEY.md §4 lesson: distributed tests run on a CPU-simulated multi-device
+mesh — the TPU analogue of the reference's multiprocess-on-one-host trick
+(test_dist_base.py:783). Must set XLA flags before jax import.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell may point at a TPU tunnel
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+# sitecustomize may have imported jax already (TPU tunnel images), in which
+# case the env var is too late — force the config directly before any backend
+# is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,62 @@
+"""OpTest-style helpers.
+
+Reference: ``python/paddle/fluid/tests/unittests/op_test.py:282`` — numeric
+output check vs numpy reference + finite-difference gradient check against
+the recorded autograd. Same methodology, JAX-native.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(op_fn, np_fn, inputs, atol=1e-5, rtol=1e-5, **kwargs):
+    """op_fn(*tensors, **kwargs) vs np_fn(*arrays, **kwargs)."""
+    tensors = [paddle.to_tensor(np.asarray(a)) for a in inputs]
+    out = op_fn(*tensors, **kwargs)
+    ref = np_fn(*[np.asarray(a) for a in inputs], **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(
+            np.asarray(o.numpy(), dtype=np.float64) if o.dtype != np.dtype("bool") else o.numpy(),
+            np.asarray(r, dtype=np.float64) if np.asarray(r).dtype != np.bool_ else r,
+            atol=atol, rtol=rtol,
+        )
+    return out
+
+
+def check_grad(op_fn, inputs, grad_inputs=None, eps=1e-3, atol=1e-2, rtol=1e-2, out_index=None, **kwargs):
+    """Finite-difference gradient check (fp64 host) vs autograd gradient."""
+    arrays = [np.asarray(a, dtype=np.float64) for a in inputs]
+    grad_idx = grad_inputs if grad_inputs is not None else list(range(len(arrays)))
+
+    def run(arrs):
+        tensors = [paddle.to_tensor(a.astype(np.float32), stop_gradient=False) for a in arrs]
+        out = op_fn(*tensors, **kwargs)
+        if out_index is not None:
+            out = out[out_index]
+        return tensors, out
+
+    tensors, out = run(arrays)
+    loss = out.sum() if out.size > 1 else out
+    loss.backward()
+
+    for gi in grad_idx:
+        analytic = tensors[gi].grad.numpy().astype(np.float64)
+        numeric = np.zeros_like(arrays[gi])
+        flat = arrays[gi].reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for k in range(flat.size):
+            orig = flat[k]
+            flat[k] = orig + eps
+            _, out_p = run(arrays)
+            f_p = float(np.asarray(out_p.numpy(), np.float64).sum())
+            flat[k] = orig - eps
+            _, out_m = run(arrays)
+            f_m = float(np.asarray(out_m.numpy(), np.float64).sum())
+            flat[k] = orig
+            num_flat[k] = (f_p - f_m) / (2 * eps)
+        np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol)

@@ -1,0 +1,157 @@
+"""Autograd engine tests (reference: imperative basic_engine + OpTest
+check_grad finite differences)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad
+
+
+class TestBackward:
+    def test_scalar_chain(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x * x  # y = x^3, dy/dx = 3x^2 = 12
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 12.0, rtol=1e-6)
+
+    def test_grad_accumulation_multi_use(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+        y = x * 2 + x * 3  # dy/dx = 5
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_repeated_backward_accumulates(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), 5.0)
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        y = paddle.to_tensor(2.0, stop_gradient=True)
+        z = x * y
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2.0)
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = (x * 2).detach()
+        z = y * x
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 6.0)
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient and y._grad_node is None
+
+    def test_diamond_graph(self):
+        x = paddle.to_tensor(np.array(2.0, np.float32), stop_gradient=False)
+        a = x * 3
+        b = x * 4
+        c = a * b  # c = 12 x^2; dc/dx = 24x = 48
+        c.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 48.0, rtol=1e-6)
+
+    def test_non_scalar_backward_needs_grad_tensor(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y.backward(paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+    def test_register_hook(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        x.register_hook(lambda g: g * 10)
+        (x * 2).backward()
+        np.testing.assert_allclose(x.grad.numpy(), 20.0)
+
+    def test_multi_output_op_grad(self):
+        x = paddle.to_tensor(np.random.rand(4, 3).astype(np.float32), stop_gradient=False)
+        parts = paddle.split(x, 3, axis=1)
+        loss = parts[0].sum() + 2 * parts[2].sum()
+        loss.backward()
+        g = x.grad.numpy()
+        np.testing.assert_allclose(g[:, 0], 1.0)
+        np.testing.assert_allclose(g[:, 1], 0.0)
+        np.testing.assert_allclose(g[:, 2], 2.0)
+
+
+class TestFiniteDifference:
+    def test_tanh(self):
+        check_grad(paddle.tanh, [np.random.rand(3, 3)])
+
+    def test_softmax(self):
+        check_grad(lambda x: paddle.nn.functional.softmax(x, -1), [np.random.rand(2, 5)])
+
+    def test_layer_norm(self):
+        check_grad(
+            lambda x: paddle.nn.functional.layer_norm(x, 4), [np.random.rand(3, 4)], atol=3e-2
+        )
+
+    def test_conv2d(self):
+        check_grad(
+            lambda x, w: paddle.nn.functional.conv2d(x, w, padding=1),
+            [np.random.rand(1, 2, 5, 5), np.random.rand(3, 2, 3, 3)],
+        )
+
+    def test_gather_grad(self):
+        idx = paddle.to_tensor(np.array([0, 2]))
+        check_grad(lambda x: paddle.gather(x, idx, axis=0), [np.random.rand(4, 3)])
+
+
+class TestPaddleGrad:
+    def test_basic(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), 4.0)
+        assert x.grad is None  # paddle.grad does not touch .grad
+
+    def test_intermediate(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        h = x * 2
+        y = h * h
+        (gh,) = paddle.grad(y, h)
+        np.testing.assert_allclose(gh.numpy(), 12.0)
+
+    def test_allow_unused(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        z = paddle.to_tensor(1.0, stop_gradient=False)
+        y = x * 2
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+
+    def test_double_grad(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x * x
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        (g2,) = paddle.grad(g1, x)
+        np.testing.assert_allclose(g2.numpy(), 12.0, rtol=1e-5)  # d2(x^3)=6x
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), 6.0)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2.0)
+
+    def test_jacobian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        jac = paddle.autograd.jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]), rtol=1e-6)

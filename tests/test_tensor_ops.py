@@ -1,0 +1,239 @@
+"""Tensor op numeric tests (reference OpTest pattern, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+class TestElementwise:
+    def test_add(self):
+        check_output(paddle.add, np.add, [np.random.rand(3, 4), np.random.rand(3, 4)])
+
+    def test_add_broadcast(self):
+        check_output(paddle.add, np.add, [np.random.rand(3, 4), np.random.rand(4)])
+
+    def test_sub_scalar(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        np.testing.assert_allclose((x - 1.5).numpy(), -0.5 * np.ones((2, 2)))
+        np.testing.assert_allclose((1.5 - x).numpy(), 0.5 * np.ones((2, 2)))
+
+    def test_mul_div(self):
+        a, b = np.random.rand(5), np.random.rand(5) + 0.5
+        check_output(paddle.multiply, np.multiply, [a, b])
+        check_output(paddle.divide, np.divide, [a, b])
+
+    def test_pow(self):
+        check_output(lambda x: paddle.pow(x, 2.0), lambda x: x**2, [np.random.rand(4)])
+
+    def test_maximum_minimum(self):
+        a, b = np.random.randn(3, 3), np.random.randn(3, 3)
+        check_output(paddle.maximum, np.maximum, [a, b])
+        check_output(paddle.minimum, np.minimum, [a, b])
+
+    def test_dtype_preserved(self):
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        assert (x * 2).dtype == np.dtype("float32")
+        assert (x + 1).dtype == np.dtype("float32")
+
+
+class TestUnary:
+    @pytest.mark.parametrize(
+        "name", ["exp", "log", "sqrt", "tanh", "sin", "cos", "abs", "floor", "ceil", "sigmoid"]
+    )
+    def test_match_numpy(self, name):
+        np_map = {"sigmoid": lambda x: 1 / (1 + np.exp(-x))}
+        data = np.random.rand(4, 3).astype(np.float64) + 0.1
+        np_fn = np_map[name] if name in np_map else getattr(np, name)
+        check_output(getattr(paddle, name), np_fn, [data], atol=1e-4, rtol=1e-3)
+
+    def test_clip(self):
+        check_output(
+            lambda x: paddle.clip(x, 0.2, 0.8), lambda x: np.clip(x, 0.2, 0.8), [np.random.rand(10)]
+        )
+
+
+class TestReduce:
+    def test_sum_axes(self):
+        x = np.random.rand(2, 3, 4)
+        check_output(lambda t: paddle.sum(t), lambda a: np.sum(a), [x])
+        check_output(lambda t: paddle.sum(t, axis=1), lambda a: np.sum(a, axis=1), [x])
+        check_output(
+            lambda t: paddle.sum(t, axis=[0, 2], keepdim=True),
+            lambda a: np.sum(a, axis=(0, 2), keepdims=True),
+            [x],
+        )
+
+    def test_mean_max_min_prod(self):
+        x = np.random.rand(3, 4)
+        check_output(lambda t: paddle.mean(t, axis=0), lambda a: np.mean(a, axis=0), [x])
+        check_output(lambda t: paddle.max(t, axis=1), lambda a: np.max(a, axis=1), [x])
+        check_output(lambda t: paddle.min(t), lambda a: np.min(a), [x])
+        check_output(lambda t: paddle.prod(t, axis=0), lambda a: np.prod(a, axis=0), [x])
+
+    def test_argmax_int64(self):
+        x = paddle.to_tensor(np.random.rand(3, 5))
+        out = paddle.argmax(x, axis=1)
+        assert out.dtype == np.dtype("int64")
+        np.testing.assert_array_equal(out.numpy(), np.argmax(x.numpy(), axis=1))
+
+    def test_std_var_unbiased(self):
+        x = np.random.rand(10)
+        check_output(lambda t: paddle.std(t), lambda a: np.std(a, ddof=1), [x])
+        check_output(lambda t: paddle.var(t, unbiased=False), lambda a: np.var(a), [x])
+
+    def test_cumsum(self):
+        x = np.random.rand(3, 4)
+        check_output(lambda t: paddle.cumsum(t, axis=1), lambda a: np.cumsum(a, axis=1), [x])
+        check_output(lambda t: paddle.cumsum(t), lambda a: np.cumsum(a.reshape(-1)), [x])
+
+
+class TestMatmul:
+    def test_2d(self):
+        check_output(paddle.matmul, np.matmul, [np.random.rand(3, 4), np.random.rand(4, 5)])
+
+    def test_batched(self):
+        check_output(paddle.matmul, np.matmul, [np.random.rand(2, 3, 4), np.random.rand(2, 4, 5)])
+
+    def test_transpose_flags(self):
+        a, b = np.random.rand(4, 3), np.random.rand(4, 5)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b), transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+    def test_grad(self):
+        check_grad(paddle.matmul, [np.random.rand(3, 4), np.random.rand(4, 2)])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+        check_output(lambda t: paddle.reshape(t, [4, 6]), lambda a: a.reshape(4, 6), [x])
+        check_output(lambda t: paddle.transpose(t, [2, 0, 1]), lambda a: a.transpose(2, 0, 1), [x])
+
+    def test_concat_stack_split(self):
+        a, b = np.random.rand(2, 3), np.random.rand(2, 3)
+        out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 0))
+        out = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+        np.testing.assert_allclose(out.numpy(), np.stack([a, b], 1))
+        parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(paddle.to_tensor(a), [1, 2], axis=1)
+        assert parts[1].shape == [2, 2]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = np.random.rand(1, 3, 1, 4)
+        check_output(lambda t: paddle.squeeze(t), lambda a: np.squeeze(a), [x])
+        check_output(lambda t: paddle.unsqueeze(t, 0), lambda a: a[None], [x])
+        check_output(lambda t: paddle.flatten(t, 1, 2), lambda a: a.reshape(1, 3, 4), [x])
+
+    def test_gather_index_select(self):
+        x = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx), axis=0)
+        np.testing.assert_allclose(out.numpy(), x[idx])
+
+    def test_getitem(self):
+        x = np.random.rand(4, 5, 6).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t[1].numpy(), x[1])
+        np.testing.assert_allclose(t[1:3, ::2].numpy(), x[1:3, ::2])
+        np.testing.assert_allclose(t[:, -1].numpy(), x[:, -1])
+        np.testing.assert_allclose(t[..., 0].numpy(), x[..., 0])
+        mask = x[:, 0, 0] > 0.5
+        np.testing.assert_allclose(t[paddle.to_tensor(mask)].numpy(), x[mask])
+
+    def test_setitem(self):
+        x = np.zeros((3, 3), np.float32)
+        t = paddle.to_tensor(x)
+        t[1] = 5.0
+        assert t.numpy()[1].sum() == 15.0
+        t[0, 2] = 7.0
+        assert t.numpy()[0, 2] == 7.0
+
+    def test_topk_sort(self):
+        x = np.random.rand(3, 6)
+        vals, idx = paddle.topk(paddle.to_tensor(x), 2, axis=1)
+        ref = np.sort(x, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+        out = paddle.sort(paddle.to_tensor(x), axis=1)
+        np.testing.assert_allclose(out.numpy(), np.sort(x, axis=1), rtol=1e-6)
+
+    def test_where(self):
+        c = np.array([True, False, True])
+        a, b = np.ones(3, np.float32), np.zeros(3, np.float32)
+        out = paddle.where(paddle.to_tensor(c), paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.where(c, a, b))
+
+    def test_pad(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        out = paddle.nn.functional.pad(paddle.to_tensor(x), [1, 1], value=0.5)
+        assert out.shape == [2, 5]
+
+    def test_tile_expand(self):
+        x = np.random.rand(1, 3).astype(np.float32)
+        np.testing.assert_allclose(paddle.tile(paddle.to_tensor(x), [2, 2]).numpy(), np.tile(x, (2, 2)))
+        np.testing.assert_allclose(
+            paddle.expand(paddle.to_tensor(x), [4, 3]).numpy(), np.broadcast_to(x, (4, 3))
+        )
+
+
+class TestLinalg:
+    def test_inv_det_solve(self):
+        a = np.random.rand(4, 4) + 4 * np.eye(4)
+        check_output(paddle.linalg.inv, np.linalg.inv, [a], atol=1e-4)
+        check_output(paddle.linalg.det, np.linalg.det, [a], atol=1e-3, rtol=1e-3)
+        b = np.random.rand(4, 2)
+        check_output(paddle.linalg.solve, lambda x, y: np.linalg.solve(x, y), [a, b], atol=1e-4)
+
+    def test_cholesky_qr_svd(self):
+        a = np.random.rand(3, 3)
+        spd = a @ a.T + 3 * np.eye(3)
+        L = paddle.linalg.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, atol=1e-4)
+        q, r = paddle.linalg.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-5)
+        u, s, v = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()[None]) @ v.numpy().T, a, atol=1e-5
+        )
+
+    def test_norm(self):
+        x = np.random.rand(3, 4)
+        check_output(lambda t: paddle.linalg.norm(t), lambda a: np.linalg.norm(a), [x])
+        check_output(
+            lambda t: paddle.linalg.norm(t, p=1, axis=1), lambda a: np.abs(a).sum(1), [x]
+        )
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], dtype="int64").dtype == np.dtype("int64")
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        np.testing.assert_allclose(paddle.full([2], 3.5).numpy(), np.full(2, 3.5, np.float32))
+
+    def test_default_dtype_float(self):
+        assert paddle.to_tensor([1.0, 2.0]).dtype == np.dtype("float32")
+        assert paddle.to_tensor([1, 2]).dtype == np.dtype("int64")
+
+    def test_tril_triu(self):
+        x = np.random.rand(4, 4)
+        check_output(paddle.tril, np.tril, [x])
+        check_output(paddle.triu, np.triu, [x])
+
+    def test_random_shapes(self):
+        assert paddle.rand([3, 3]).shape == [3, 3]
+        assert paddle.randn([2, 2]).dtype == np.dtype("float32")
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+    def test_seed_reproducible(self):
+        paddle.seed(7)
+        a = paddle.rand([4]).numpy()
+        paddle.seed(7)
+        b = paddle.rand([4]).numpy()
+        np.testing.assert_array_equal(a, b)
