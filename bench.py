@@ -1,0 +1,104 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Flagship config: GPT (BASELINE.md north star is GPT-3 1.3B on a v4-32 pod;
+single-chip bench runs a ~350M-parameter GPT at seq 1024 in bf16 through the
+fused compiled train step). Metric: tokens/sec/chip.
+
+The reference publishes no in-tree numbers (BASELINE.md) — vs_baseline is
+reported against this project's own recorded best (bench_baseline.json),
+1.0 on first run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    t_start = time.time()
+    import numpy as np
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    if on_tpu:
+        cfg = GPTConfig(
+            vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
+            max_position_embeddings=1024, hidden_dropout=0.0, attention_dropout=0.0,
+        )
+        batch, seq, steps = 8, 1024, 10
+    else:  # smoke fallback (driver runs on real TPU)
+        cfg = GPTConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+            max_position_embeddings=256, hidden_dropout=0.0, attention_dropout=0.0,
+        )
+        batch, seq, steps = 8, 256, 10
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    model.bfloat16()  # MXU-native dtype
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def loss_fn(m, ids, labels):
+        return m.loss(ids, labels)
+
+    step = paddle.jit.compile_train_step(model, loss_fn, opt)
+
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)))
+        labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)))
+        return ids, labels
+
+    ids, labels = make_batch()
+    # warmup / compile
+    loss = step(ids, labels)
+    loss2 = step(ids, labels)
+    float(loss2.item())
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    final = float(loss.item())  # forces sync
+    dt = time.time() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    n_params = sum(p.size for p in model.parameters())
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    vs_baseline = 1.0
+    try:
+        if os.path.exists(baseline_path):
+            base = json.load(open(baseline_path))
+            if base.get("value") and base.get("platform") == jax.devices()[0].platform:
+                vs_baseline = tokens_per_sec / float(base["value"])
+        elif on_tpu:  # record the first real-hardware number as the baseline
+            json.dump(
+                {"value": tokens_per_sec, "unit": "tokens/sec/chip", "platform": jax.devices()[0].platform},
+                open(baseline_path, "w"),
+            )
+    except Exception:
+        pass
+
+    print(
+        json.dumps(
+            {
+                "metric": f"GPT-{n_params/1e6:.0f}M bf16 train throughput (b{batch}xs{seq}, fused step)",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(vs_baseline, 3),
+                "loss": round(final, 4),
+                "platform": jax.devices()[0].platform,
+                "wall_s": round(time.time() - t_start, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

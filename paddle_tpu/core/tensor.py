@@ -39,6 +39,10 @@ class Tensor:
         "name",
         "persistable",
         "_backward_hooks",
+        # distributed layout annotations (GSPMD PartitionSpecs)
+        "pspec",
+        "opt_state_pspec",
+        "grad_pspec",
         "__weakref__",
     )
 
@@ -63,6 +67,9 @@ class Tensor:
         self.name = name or _next_name()
         self.persistable = False
         self._backward_hooks = []
+        self.pspec = None
+        self.opt_state_pspec = None
+        self.grad_pspec = None
 
     # -- metadata ---------------------------------------------------------
     @property

@@ -93,16 +93,34 @@ def _is_traced(arr) -> bool:
     return isinstance(arr, jax.core.Tracer)
 
 
+def _axis_bound(axis: str) -> bool:
+    """True only inside a shard_map/pmap scope where ``axis`` is a manual
+    axis. Under plain jit/GSPMD this is False — the partitioner owns comms
+    there and explicit collectives must be identities."""
+    try:
+        lax.axis_size(axis)
+        return True
+    except Exception:
+        return False
+
+
 def _axis(group):
     if group is not None and group.axis_name:
         return group.axis_name
     return None
 
 
+def _manual(t, group):
+    axis = _axis(group)
+    if axis is None or not _is_traced(t._data):
+        return None
+    return axis if _axis_bound(axis) else None
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
     t = as_tensor(tensor)
     axis = _axis(group)
-    if _is_traced(t._data) and axis is not None:
+    if _is_traced(t._data) and axis is not None and _axis_bound(axis):
         fns = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax, ReduceOp.MIN: lax.pmin}
         if op == ReduceOp.AVG:
             out = lax.pmean(t._data, axis)
@@ -121,7 +139,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_strea
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     t = as_tensor(tensor)
     axis = _axis(group)
-    if _is_traced(t._data) and axis is not None:
+    if _is_traced(t._data) and axis is not None and _axis_bound(axis):
         gathered = lax.all_gather(t._data, axis)
         n = gathered.shape[0]
         if isinstance(tensor_list, list):
@@ -137,7 +155,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 def all_gather_into_tensor(out, tensor, group=None, sync_op=True, concat_axis=0):
     t = as_tensor(tensor)
     axis = _axis(group)
-    if _is_traced(t._data) and axis is not None:
+    if _is_traced(t._data) and axis is not None and _axis_bound(axis):
         g = lax.all_gather(t._data, axis)
         arr = jnp.concatenate([g[i] for i in range(g.shape[0])], axis=concat_axis)
         return Tensor(arr)
@@ -152,7 +170,7 @@ def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
 def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None, sync_op=True):
     inp = as_tensor(tensor_list_or_input if not isinstance(tensor_list_or_input, list) else tensor_list_or_input[0])
     axis = _axis(group)
-    if _is_traced(inp._data) and axis is not None:
+    if _is_traced(inp._data) and axis is not None and _axis_bound(axis):
         out = lax.psum_scatter(inp._data, axis, scatter_dimension=0, tiled=True)
         if isinstance(tensor, Tensor):
             tensor._data = out
@@ -163,7 +181,7 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None, sy
 def broadcast(tensor, src=0, group=None, sync_op=True):
     t = as_tensor(tensor)
     axis = _axis(group)
-    if _is_traced(t._data) and axis is not None:
+    if _is_traced(t._data) and axis is not None and _axis_bound(axis):
         idx = lax.axis_index(axis)
         src_val = lax.all_gather(t._data, axis)[src]
         if isinstance(tensor, Tensor):
@@ -175,7 +193,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     t = as_tensor(tensor)
     axis = _axis(group)
-    if _is_traced(t._data) and axis is not None and tensor_list is not None:
+    if _is_traced(t._data) and axis is not None and _axis_bound(axis) and tensor_list is not None:
         stacked = jnp.stack([as_tensor(x)._data for x in tensor_list])
         idx = lax.axis_index(axis)
         out = stacked[idx]
@@ -192,7 +210,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         x = jnp.stack([as_tensor(t)._data for t in in_tensor_list])
     else:
         x = as_tensor(in_tensor_list)._data
-    if _is_traced(x) and axis is not None:
+    if _is_traced(x) and axis is not None and _axis_bound(axis):
         out = lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
         if isinstance(out_tensor_list, list):
             out_tensor_list.extend(Tensor(out[i]) for i in range(out.shape[0]))
@@ -213,7 +231,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
     t = as_tensor(in_tensor)
     axis = _axis(group)
-    if _is_traced(t._data) and axis is not None:
+    if _is_traced(t._data) and axis is not None and _axis_bound(axis):
         out = lax.all_to_all(t._data, axis, split_axis=0, concat_axis=0, tiled=True)
         if isinstance(out_tensor, Tensor):
             out_tensor._data = out
@@ -258,7 +276,7 @@ def _c_identity(tensor, group=None):
     """Forward identity; backward all-reduce (column-parallel input)."""
     t = as_tensor(tensor)
     axis = _axis(group)
-    if not (_is_traced(t._data) and axis is not None):
+    if not (_is_traced(t._data) and axis is not None and _axis_bound(axis)):
         return t
 
     @jax.custom_vjp
@@ -279,7 +297,7 @@ def _mp_allreduce(tensor, group=None):
     """Forward all-reduce; backward identity (row-parallel output)."""
     t = as_tensor(tensor)
     axis = _axis(group)
-    if not (_is_traced(t._data) and axis is not None):
+    if not (_is_traced(t._data) and axis is not None and _axis_bound(axis)):
         return t
 
     @jax.custom_vjp
@@ -300,7 +318,7 @@ def _c_split(tensor, group=None):
     """Split along last dim, keep this rank's shard (fwd); all-gather (bwd)."""
     t = as_tensor(tensor)
     axis = _axis(group)
-    if not (_is_traced(t._data) and axis is not None):
+    if not (_is_traced(t._data) and axis is not None and _axis_bound(axis)):
         return t
     n = group.nranks
 
@@ -316,7 +334,7 @@ def _c_concat(tensor, group=None):
     """All-gather along last dim (column-parallel output gather)."""
     t = as_tensor(tensor)
     axis = _axis(group)
-    if not (_is_traced(t._data) and axis is not None):
+    if not (_is_traced(t._data) and axis is not None and _axis_bound(axis)):
         return t
 
     def fn(x):
@@ -331,7 +349,7 @@ def _c_softmax_with_cross_entropy(logits, label, group=None, ignore_index=-100):
     across the mp axis; per-rank partial max/sum are all-reduced."""
     lg, lb = as_tensor(logits), as_tensor(label)
     axis = _axis(group)
-    if not (_is_traced(lg._data) and axis is not None):
+    if not (_is_traced(lg._data) and axis is not None and _axis_bound(axis)):
         from ..nn.functional.loss import cross_entropy
 
         return cross_entropy(lg, lb, reduction="none", ignore_index=ignore_index)

@@ -1,0 +1,179 @@
+"""Hybrid-parallel training engine.
+
+Parity: the reference's hybrid train loop (fleet.distributed_model +
+HybridParallelOptimizer + per-op NCCL collectives, SURVEY.md §3.4). TPU-native
+formulation: ONE compiled XLA program per train step —
+
+ * params carry NamedShardings from their PartitionSpecs (Megatron 'mp'
+   column/row specs from mp_layers, ZeRO specs from sharding stages);
+ * the batch is sharded over 'dp' (and 'sp' for sequence parallel);
+ * GSPMD partitions every matmul and inserts the all-reduces /
+   reduce-scatters / all-gathers the reference codes as c_allreduce_sum /
+   partial_* ops, scheduled by XLA's latency-hiding scheduler over ICI;
+ * optimizer state sharded over the ZeRO axis makes the weight update a
+   sharded computation (ZeRO-1/2 semantics) with an all-gather of updated
+   params — "Automatic Cross-Replica Sharding of Weight Update" (PAPERS.md).
+
+The engine is the TPU replacement for the reference's per-op executor hot
+loop + DDP reducer + sharding-stage hooks, collapsed into compile time.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import random as random_state
+from ..core.engine import no_grad
+from ..core.tensor import Tensor
+from .mesh import global_mesh
+
+
+def _sharding(mesh: Mesh, spec) -> NamedSharding:
+    if spec is None:
+        spec = P()
+    valid_axes = set(mesh.axis_names)
+    cleaned = []
+    for s in tuple(spec):
+        if s is None or (isinstance(s, str) and s in valid_axes):
+            cleaned.append(s)
+        elif isinstance(s, (list, tuple)):
+            cleaned.append(tuple(a for a in s if a in valid_axes) or None)
+        else:
+            cleaned.append(None)
+    return NamedSharding(mesh, P(*cleaned))
+
+
+class HybridParallelEngine:
+    """Compile (params, opt_state, batch) → (loss, params', opt_state') once;
+    every subsequent step is one executable launch.
+
+    ``loss_fn(model, *batch_tensors) -> scalar Tensor``.
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer,
+        loss_fn: Callable,
+        mesh: Optional[Mesh] = None,
+        batch_specs: Optional[Sequence] = None,
+        dp_axes=("dp",),
+        grad_accumulate: int = 1,
+        donate: bool = True,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh or global_mesh()
+        self.batch_specs = batch_specs
+        self.dp_axes = dp_axes
+        self.donate = donate
+        self.params = [p for p in model.parameters() if not p.stop_gradient]
+        self.buffers = list(model.buffers())
+        self._jit = None
+        self._placed = False
+
+    # -- placement ---------------------------------------------------------
+    def place(self):
+        """device_put params per their PartitionSpecs (GSPMD layout)."""
+        if self._placed:
+            return
+        for p in self.params + self.buffers:
+            spec = getattr(p, "pspec", None)
+            p._set_data(jax.device_put(p._data, _sharding(self.mesh, spec)))
+        self._placed = True
+
+    def _opt_sharding(self, p):
+        spec = getattr(p, "opt_state_pspec", None) or getattr(p, "pspec", None)
+        return _sharding(self.mesh, spec)
+
+    def _batch_sharding(self, i, arr):
+        if self.batch_specs is not None and i < len(self.batch_specs):
+            return _sharding(self.mesh, self.batch_specs[i])
+        # default: shard dim0 over dp axes present in the mesh
+        axes = tuple(a for a in self.dp_axes if a in self.mesh.axis_names)
+        spec = [axes if axes else None] + [None] * (arr.ndim - 1)
+        return _sharding(self.mesh, P(*spec))
+
+    # -- compiled step -----------------------------------------------------
+    def _build(self):
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        params, buffers = self.params, self.buffers
+
+        def step_fn(param_arrays, opt_state, batch_arrays, lr, key):
+            def loss_of(p_arrays):
+                saved = [(t, t._data) for t in params + buffers]
+                try:
+                    for t, a in zip(params, p_arrays):
+                        t._data = a
+                    inputs = [Tensor(a, stop_gradient=True) for a in batch_arrays]
+                    with random_state.traced_keys(key):
+                        with no_grad():
+                            out = loss_fn(model, *inputs)
+                    return out._data if isinstance(out, Tensor) else out
+                finally:
+                    for t, a in saved:
+                        t._data = a
+
+            loss, grads = jax.value_and_grad(loss_of)(list(param_arrays))
+            new_params, new_state = opt._functional_update(
+                param_arrays, grads, opt_state, lr, params=params
+            )
+            return loss, new_params, new_state
+
+        donate = (0, 1) if self.donate else ()
+        self._jit = jax.jit(step_fn, donate_argnums=donate)
+
+    @no_grad()
+    def train_step(self, *batch):
+        self.place()
+        if self._jit is None:
+            self._build()
+        batch_arrays = []
+        for i, b in enumerate(batch):
+            arr = b._data if isinstance(b, Tensor) else jnp.asarray(b)
+            batch_arrays.append(jax.device_put(arr, self._batch_sharding(i, arr)))
+        param_arrays = [p._data for p in self.params]
+        opt_state = self.optimizer._functional_state(self.params)
+        # ZeRO: shard accumulators over the sharding axis
+        opt_state["accums"] = [
+            {k: jax.device_put(v, self._opt_sharding(p)) for k, v in st.items()}
+            for p, st in zip(self.params, opt_state["accums"])
+        ]
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = random_state.next_key()
+        loss, new_params, new_state = self._jit(
+            param_arrays, opt_state, tuple(batch_arrays), lr, key
+        )
+        for p, a in zip(self.params, new_params):
+            p._set_data(a)
+        self.optimizer._functional_restore(self.params, new_state)
+        self.optimizer._step_count += 1
+        return Tensor(loss)
+
+    @no_grad()
+    def eval_step(self, fn, *batch):
+        self.place()
+        arrays = [
+            jax.device_put(
+                b._data if isinstance(b, Tensor) else jnp.asarray(b),
+                self._batch_sharding(i, b._data if isinstance(b, Tensor) else jnp.asarray(b)),
+            )
+            for i, b in enumerate(batch)
+        ]
+        inputs = [Tensor(a, stop_gradient=True) for a in arrays]
+        return fn(self.model, *inputs)
+
+
+def shard_model_params(model, mesh=None):
+    """Apply each param's pspec placement without building an engine."""
+    mesh = mesh or global_mesh()
+    for p in model.parameters():
+        p._set_data(jax.device_put(p._data, _sharding(mesh, getattr(p, "pspec", None))))
+    for b in model.buffers():
+        b._set_data(jax.device_put(b._data, _sharding(mesh, None)))
+    return model

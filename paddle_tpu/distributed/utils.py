@@ -21,7 +21,8 @@ def global_scatter(x, local_count, global_count, group=None):
     """Send token rows to expert owners across the ep axis (all-to-all)."""
     t = as_tensor(x)
     axis = group.axis_name if group is not None else None
-    if isinstance(t._data, jax.core.Tracer) and axis is not None:
+    from .collective import _axis_bound
+    if isinstance(t._data, jax.core.Tracer) and axis is not None and _axis_bound(axis):
         def fn(a):
             return lax.all_to_all(a, axis, split_axis=0, concat_axis=0, tiled=True)
 
@@ -32,7 +33,8 @@ def global_scatter(x, local_count, global_count, group=None):
 def global_gather(x, local_count, global_count, group=None):
     t = as_tensor(x)
     axis = group.axis_name if group is not None else None
-    if isinstance(t._data, jax.core.Tracer) and axis is not None:
+    from .collective import _axis_bound
+    if isinstance(t._data, jax.core.Tracer) and axis is not None and _axis_bound(axis):
         def fn(a):
             return lax.all_to_all(a, axis, split_axis=0, concat_axis=0, tiled=True)
 

@@ -1,0 +1,209 @@
+"""GPT model family — the flagship training config (BASELINE: GPT-3 1.3B).
+
+Parity: the reference trains GPT via PaddleNLP on Fleet hybrid parallel
+(BASELINE.json); the in-tree building blocks are the fused transformer ops
+(``paddle/fluid/operators/fused/fused_attention_op.cc``) and the Megatron
+layers (``fleet/meta_parallel/parallel_layers/mp_layers.py``). This model is
+built TPU-first:
+
+ * every matmul is a Megatron-shardable layer — weights carry PartitionSpecs
+   ("mp" column/row sharding) that GSPMD partitions when compiled on a mesh;
+ * sequence-parallel activations: hidden states carry ("dp", "sp") sharding
+   constraints so long sequences shard over the 'sp' axis;
+ * attention runs through the fused scaled_dot_product_attention functional
+   (Pallas flash kernel on TPU) or ring attention under explicit shard_map;
+ * the decoder stack is uniform — pipeline-stageable by construction
+   (pp_layers.PipelineLayer segments it; the spmd pipeline stacks it).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding, ParallelCrossEntropy,
+)
+from ..distributed.sharding_api import shard_tensor
+
+try:
+    from jax.sharding import PartitionSpec as P
+except Exception:  # pragma: no cover
+    P = None
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 1024
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    initializer_range: float = 0.02
+    use_mp_layers: bool = True  # Megatron-shardable weights (GSPMD specs)
+    sequence_parallel: bool = False  # annotate activations with 'sp'
+
+    @property
+    def ffn_size(self):
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+def _sp_constrain(x, config):
+    """Sequence-parallel activation sharding: (B, T, H) → P('dp','sp',None)."""
+    if config.sequence_parallel and P is not None:
+        try:
+            return shard_tensor(x, placement=P("dp", "sp", None))
+        except Exception:
+            return x
+    return x
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_heads
+        self.head_dim = h // config.num_heads
+        self.qkv = ColumnParallelLinear(h, 3 * h, has_bias=True, gather_output=False)
+        self.proj = RowParallelLinear(h, h, has_bias=True, input_is_parallel=True)
+        self.attn_dropout = config.attention_dropout
+        self.config = config
+
+    def forward(self, x, attn_mask=None):
+        B, T = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)  # (B, T, 3H/mp)
+        local_h = qkv.shape[-1] // 3
+        local_heads = local_h // self.head_dim
+        qkv = qkv.reshape([B, T, 3, local_heads, self.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
+            dropout_p=self.attn_dropout, training=self.training,
+        )
+        out = out.reshape([B, T, local_h])
+        return self.proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.up = ColumnParallelLinear(h, config.ffn_size, has_bias=True, gather_output=False)
+        self.down = RowParallelLinear(config.ffn_size, h, has_bias=True, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down(F.gelu(self.up(x), approximate=True))
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-LN decoder block — the uniform pipeline stage unit."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(config.hidden_size, epsilon=1e-5)
+        self.attn = GPTAttention(config)
+        self.ln2 = nn.LayerNorm(config.hidden_size, epsilon=1e-5)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.hidden_dropout)
+        self.config = config
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.dropout(self.attn(self.ln1(x), attn_mask))
+        x = _sp_constrain(x, self.config)
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return _sp_constrain(x, self.config)
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = nn.initializer.Normal(std=config.initializer_range)
+        if config.use_mp_layers:
+            self.word_embeddings = VocabParallelEmbedding(config.vocab_size, config.hidden_size, weight_attr=init)
+        else:
+            self.word_embeddings = nn.Embedding(config.vocab_size, config.hidden_size, weight_attr=init)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings, config.hidden_size, weight_attr=init)
+        self.dropout = nn.Dropout(config.hidden_dropout)
+        self.config = config
+
+    def forward(self, input_ids, position_ids=None):
+        from ..ops.creation import arange
+
+        T = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = arange(T, dtype="int64").unsqueeze(0)
+        x = self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
+        return _sp_constrain(self.dropout(x), self.config)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = nn.LayerList([GPTDecoderLayer(config) for _ in range(config.num_layers)])
+        self.final_ln = nn.LayerNorm(config.hidden_size, epsilon=1e-5)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        x = self.embeddings(input_ids, position_ids)
+        for layer in self.layers:
+            x = layer(x, attn_mask)
+        return self.final_ln(x)
+
+
+class GPTForPretraining(nn.Layer):
+    """LM head tied to the word embedding (reference: SharedLayerDesc tying)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        x = self.gpt(input_ids, position_ids, attn_mask)
+        w = self.gpt.embeddings.word_embeddings.weight
+        logits = F.linear(x, _transpose(w))
+        return logits
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1])
+        )
+
+
+def _transpose(w):
+    from ..ops.manipulation import transpose
+
+    return transpose(w, [1, 0])
+
+
+# -- standard configs --------------------------------------------------------
+def gpt_tiny(**kw):
+    return GPTConfig(
+        vocab_size=1024, hidden_size=128, num_layers=4, num_heads=4,
+        max_position_embeddings=256, **kw,
+    )
+
+
+def gpt3_1p3b(**kw):
+    """GPT-3 1.3B (BASELINE north-star config)."""
+    return GPTConfig(
+        vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
+        max_position_embeddings=2048, **kw,
+    )
+
+
+def gpt3_13b(**kw):
+    return GPTConfig(
+        vocab_size=50304, hidden_size=5120, num_layers=40, num_heads=40,
+        max_position_embeddings=2048, **kw,
+    )
