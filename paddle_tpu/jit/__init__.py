@@ -73,11 +73,11 @@ class StaticFunction:
         buffers = [b for _, b in self._layer.named_buffers()]
         return params, buffers
 
-    def _pure(self, n_params, n_buffers, key):
+    def _pure(self, n_params, n_buffers):
         fn = self._fn
         layer = self._layer
 
-        def pure(args_tuple):
+        def pure(args_tuple, key):
             param_arrays = args_tuple[:n_params]
             buffer_arrays = args_tuple[n_params : n_params + n_buffers]
             input_arrays = args_tuple[n_params + n_buffers :]
@@ -104,7 +104,7 @@ class StaticFunction:
         shape_key = tuple((tuple(a.shape), str(a.dtype)) for a in all_arrays)
 
         n_p, n_b = len(params), len(buffers)
-        pure = self._pure(n_p, n_b, key)
+        pure = self._pure(n_p, n_b)
 
         training = self._layer.training if self._layer is not None else False
         cache_key = (shape_key, training)
@@ -113,7 +113,7 @@ class StaticFunction:
         fwd = self._fwd_cache[cache_key]
 
         need_grad = grad_enabled() and any(not p.stop_gradient for p in params)
-        outs = fwd(all_arrays)
+        outs = fwd(all_arrays, key)
         single = not isinstance(outs, (tuple, list))
         out_list = [outs] if single else list(outs)
 
@@ -123,8 +123,8 @@ class StaticFunction:
 
         if cache_key not in self._bwd_cache:
 
-            def bwd(arrays_tuple, cts):
-                _, vjp_fn = jax.vjp(pure, arrays_tuple)
+            def bwd(arrays_tuple, cts, bwd_key):
+                _, vjp_fn = jax.vjp(lambda a: pure(a, bwd_key), arrays_tuple)
                 (grads,) = vjp_fn(cts)
                 return grads
 
@@ -145,7 +145,7 @@ class StaticFunction:
                 cts_tree = cts
             else:
                 cts_tree = tuple(cts)
-            grads = bwd(all_arrays, cts_tree)
+            grads = bwd(all_arrays, cts_tree, key)
             return tuple(grads)
 
         routes = []
@@ -175,8 +175,8 @@ class StaticFunction:
         params, buffers = self._params_buffers()
         input_arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
         all_arrays = tuple(p._data for p in params) + tuple(b._data for b in buffers) + tuple(input_arrays)
-        pure = self._pure(len(params), len(buffers), jax.random.PRNGKey(0))
-        return jax.jit(pure).lower(all_arrays)
+        pure = self._pure(len(params), len(buffers))
+        return jax.jit(pure).lower(all_arrays, jax.random.PRNGKey(0))
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):

@@ -60,7 +60,7 @@ class Embedding(Layer):
             default_initializer=I.XavierUniform(),
         )
         if padding_idx is not None:
-            w = np.asarray(self.weight.numpy())
+            w = self.weight.numpy().copy()
             w[padding_idx] = 0
             self.weight.set_value(w)
 

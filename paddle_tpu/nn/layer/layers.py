@@ -88,6 +88,15 @@ class Layer:
         return tensor
 
     # -- attribute magic --------------------------------------------------
+    def __getattr__(self, name):
+        # only called when normal lookup fails: check registries (buffers are
+        # registered without setattr, reference layers.py behavior)
+        for registry in ("_buffers", "_parameters", "_sub_layers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
     def __setattr__(self, name, value):
         params = self.__dict__.get("_parameters")
         layers = self.__dict__.get("_sub_layers")

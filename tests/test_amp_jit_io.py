@@ -1,0 +1,199 @@
+"""AMP, jit, and io tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestAMP:
+    def test_autocast_casts_matmul_to_bf16(self):
+        x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = paddle.matmul(x, x)
+        assert out.dtype == paddle.bfloat16
+
+    def test_blacklist_stays_fp32(self):
+        x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        with paddle.amp.auto_cast():
+            out = F.softmax(x)
+        assert out.dtype == np.dtype("float32")
+
+    def test_disabled_outside_context(self):
+        x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        out = paddle.matmul(x, x)
+        assert out.dtype == np.dtype("float32")
+
+    def test_grad_scaler_scales_and_steps(self):
+        model = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+        loss = model(x).mean()
+        before = model.weight.numpy().copy()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        assert not np.allclose(model.weight.numpy(), before)
+
+    def test_grad_scaler_skips_on_inf(self):
+        model = nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0, decr_every_n_nan_or_inf=1)
+        before = model.weight.numpy().copy()
+        model.weight.grad = paddle.to_tensor(np.full((2, 2), np.inf, np.float32))
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_array_equal(model.weight.numpy(), before)
+        assert scaler.get_scale() == 2.0  # halved
+
+    def test_decorate_o2(self):
+        model = nn.Linear(4, 4)
+        model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+        assert model.weight.dtype == paddle.bfloat16
+
+
+class TestJit:
+    def test_to_static_matches_eager(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+        eager_out = model(x).numpy()
+        static = paddle.jit.to_static(model)
+        np.testing.assert_allclose(static(x).numpy(), eager_out, rtol=1e-5)
+
+    def test_to_static_grads_match(self):
+        paddle.seed(0)
+        m1 = nn.Linear(4, 2)
+        m2 = nn.Linear(4, 2)
+        m2.set_state_dict(m1.state_dict())
+        x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+        m1(x).sum().backward()
+        sm = paddle.jit.to_static(m2)
+        sm(x).sum().backward()
+        np.testing.assert_allclose(m1.weight.grad.numpy(), m2.weight.grad.numpy(), rtol=1e-4)
+
+    def test_jit_save_load(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+
+        model = nn.Linear(4, 2)
+        model.eval()
+        path = str(tmp_path / "linear")
+        paddle.jit.save(model, path, input_spec=[InputSpec([2, 4], "float32")])
+        loaded = paddle.jit.load(path)
+        x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+        np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(), rtol=1e-5)
+
+    def test_dropout_under_jit_varies(self):
+        model = nn.Dropout(0.5)
+        sf = paddle.jit.to_static(lambda x: model(x))
+        x = paddle.to_tensor(np.ones((100,), np.float32))
+        a = sf(x).numpy()
+        b = sf(x).numpy()
+        assert not np.array_equal(a, b)  # traced RNG must advance per call
+
+
+class TestIO:
+    def test_save_load_nested(self, tmp_path):
+        obj = {
+            "w": paddle.to_tensor(np.random.rand(3, 3).astype(np.float32)),
+            "nested": {"b": paddle.to_tensor(np.arange(4))},
+            "scalar": 7,
+            "list": [paddle.to_tensor(np.ones(2, np.float32))],
+        }
+        p = str(tmp_path / "ckpt.pdparams")
+        paddle.save(obj, p)
+        loaded = paddle.load(p)
+        np.testing.assert_array_equal(loaded["w"].numpy(), obj["w"].numpy())
+        np.testing.assert_array_equal(loaded["nested"]["b"].numpy(), np.arange(4))
+        assert loaded["scalar"] == 7
+
+    def test_bfloat16_roundtrip(self, tmp_path):
+        t = paddle.to_tensor(np.random.rand(4).astype(np.float32)).astype("bfloat16")
+        p = str(tmp_path / "bf16.pdparams")
+        paddle.save({"t": t}, p)
+        loaded = paddle.load(p)
+        assert loaded["t"].dtype == paddle.bfloat16
+        np.testing.assert_array_equal(
+            loaded["t"].astype("float32").numpy(), t.astype("float32").numpy()
+        )
+
+    def test_dataloader_batching(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32), np.int64(i % 2)
+
+        dl = DataLoader(DS(), batch_size=4, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 3] and y.shape == [4]
+        dl2 = DataLoader(DS(), batch_size=4, drop_last=True)
+        assert len(list(dl2)) == 2
+
+    def test_dataloader_workers_match_serial(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.full((2,), i, np.float32)
+
+        serial = {tuple(b.numpy()[:, 0].tolist()) for b in DataLoader(DS(), batch_size=4)}
+        threaded = {tuple(b.numpy()[:, 0].tolist()) for b in DataLoader(DS(), batch_size=4, num_workers=3)}
+        assert serial == threaded
+
+    def test_worker_error_propagates(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Bad(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(DataLoader(Bad(), batch_size=2, num_workers=2))
+
+    def test_distributed_batch_sampler_partitions(self):
+        from paddle_tpu.io import DistributedBatchSampler, TensorDataset
+
+        ds = TensorDataset([paddle.to_tensor(np.arange(12))])
+        seen = []
+        for rank in range(3):
+            s = DistributedBatchSampler(ds, batch_size=2, num_replicas=3, rank=rank)
+            for batch in s:
+                seen.extend(batch)
+        assert sorted(seen) == list(range(12))
+
+    def test_hapi_model_fit(self):
+        from paddle_tpu.io import TensorDataset
+
+        paddle.seed(0)
+        x = np.random.rand(32, 4).astype(np.float32)
+        w_true = np.random.rand(4, 1).astype(np.float32)
+        y = x @ w_true
+        ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+        net = nn.Linear(4, 1)
+        model = paddle.Model(net)
+        initial = float(nn.MSELoss()(net(paddle.to_tensor(x)), paddle.to_tensor(y)).item())
+        sched = paddle.optimizer.lr.StepDecay(0.05, step_size=60, gamma=0.2)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(sched, parameters=net.parameters()),
+            loss=nn.MSELoss(),
+        )
+        model.fit(ds, batch_size=8, epochs=30, verbose=0)
+        final = model.evaluate(ds, batch_size=32)
+        assert final["loss"] < initial / 10, (initial, final)

@@ -1,0 +1,293 @@
+"""nn layer tests (reference: per-layer unittests in fluid/tests/unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLinear:
+    def test_forward_matches_manual(self):
+        layer = nn.Linear(4, 3)
+        x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+        out = layer(x)
+        ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_no_bias(self):
+        layer = nn.Linear(4, 3, bias_attr=False)
+        assert layer.bias is None
+        assert len(layer.parameters()) == 1
+
+    def test_param_registration(self):
+        layer = nn.Linear(4, 3)
+        names = [n for n, _ in layer.named_parameters()]
+        assert names == ["weight", "bias"]
+
+
+class TestConvPool:
+    def test_conv2d_shape(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = paddle.to_tensor(np.random.rand(2, 3, 16, 16).astype(np.float32))
+        assert conv(x).shape == [2, 8, 8, 8]
+
+    def test_conv2d_groups(self):
+        conv = nn.Conv2D(4, 8, 3, padding=1, groups=2)
+        x = paddle.to_tensor(np.random.rand(1, 4, 8, 8).astype(np.float32))
+        assert conv(x).shape == [1, 8, 8, 8]
+
+    def test_conv_transpose_inverts_shape(self):
+        down = nn.Conv2D(3, 8, 4, stride=2, padding=1)
+        up = nn.Conv2DTranspose(8, 3, 4, stride=2, padding=1)
+        x = paddle.to_tensor(np.random.rand(1, 3, 16, 16).astype(np.float32))
+        assert up(down(x)).shape == [1, 3, 16, 16]
+
+    def test_maxpool_vs_manual(self):
+        x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+        out = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+        ref = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_avgpool_vs_manual(self):
+        x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+        out = F.avg_pool2d(paddle.to_tensor(x), 2, 2)
+        ref = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_adaptive_avg_pool(self):
+        x = paddle.to_tensor(np.random.rand(1, 2, 7, 7).astype(np.float32))
+        out = F.adaptive_avg_pool2d(x, 1)
+        np.testing.assert_allclose(out.numpy().reshape(2), x.numpy().mean((0, 2, 3)), rtol=1e-5)
+        out = F.adaptive_avg_pool2d(x, 3)  # non-divisible path
+        assert out.shape == [1, 2, 3, 3]
+
+
+class TestNorm:
+    def test_batchnorm_train_uses_batch_stats(self):
+        bn = nn.BatchNorm2D(3)
+        x = np.random.rand(4, 3, 5, 5).astype(np.float32) * 3 + 1
+        out = bn(paddle.to_tensor(x))
+        m = out.numpy().mean(axis=(0, 2, 3))
+        v = out.numpy().var(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, 0, atol=1e-5)
+        np.testing.assert_allclose(v, 1, atol=1e-3)
+
+    def test_batchnorm_updates_running_stats(self):
+        bn = nn.BatchNorm2D(2, momentum=0.0)  # running = batch stats directly
+        x = np.random.rand(8, 2, 4, 4).astype(np.float32) * 2 + 3
+        bn(paddle.to_tensor(x))
+        np.testing.assert_allclose(bn._mean.numpy(), x.mean((0, 2, 3)), rtol=1e-4)
+
+    def test_batchnorm_eval_uses_running(self):
+        bn = nn.BatchNorm2D(2)
+        bn.eval()
+        x = np.random.rand(4, 2, 3, 3).astype(np.float32)
+        out = bn(paddle.to_tensor(x))
+        ref = (x - 0.0) / np.sqrt(1.0 + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+    def test_layernorm_matches_manual(self):
+        ln = nn.LayerNorm(6)
+        x = np.random.rand(3, 6).astype(np.float32)
+        out = ln(paddle.to_tensor(x))
+        mu = x.mean(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = paddle.to_tensor(np.random.rand(2, 4, 3, 3).astype(np.float32))
+        out = gn(x)
+        grouped = out.numpy().reshape(2, 2, 2, 3, 3)
+        np.testing.assert_allclose(grouped.mean((2, 3, 4)), 0, atol=1e-4)
+
+
+class TestDropout:
+    def test_train_scales(self):
+        paddle.seed(0)
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        out = d(x).numpy()
+        kept = out[out > 0]
+        np.testing.assert_allclose(kept, 2.0)
+        assert 300 < (out > 0).sum() < 700
+
+    def test_eval_identity(self):
+        d = nn.Dropout(0.5)
+        d.eval()
+        x = paddle.ones([10])
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+class TestEmbeddingRNN:
+    def test_embedding_lookup(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([[1, 3], [5, 1]]))
+        out = emb(idx)
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+        np.testing.assert_allclose(out.numpy()[1, 1], emb.weight.numpy()[1])
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor(np.array([0, 1])))
+        np.testing.assert_allclose(out.numpy()[0], 0.0)
+
+    def test_lstm_matches_cell_loop(self):
+        paddle.seed(1)
+        lstm = nn.LSTM(3, 5)
+        x = paddle.to_tensor(np.random.rand(2, 4, 3).astype(np.float32))
+        y, (h, c) = lstm(x)
+        assert y.shape == [2, 4, 5] and h.shape == [1, 2, 5]
+        # manual recompute with the same weights
+        w_ih = lstm._all_weights[0][0].numpy()
+        w_hh = lstm._all_weights[0][1].numpy()
+        b_ih = lstm._all_weights[0][2].numpy()
+        b_hh = lstm._all_weights[0][3].numpy()
+
+        def sig(a):
+            return 1 / (1 + np.exp(-a))
+
+        hh = np.zeros((2, 5), np.float32)
+        cc = np.zeros((2, 5), np.float32)
+        for t in range(4):
+            g = x.numpy()[:, t] @ w_ih.T + b_ih + hh @ w_hh.T + b_hh
+            i, f, gg, o = np.split(g, 4, -1)
+            cc = sig(f) * cc + sig(i) * np.tanh(gg)
+            hh = sig(o) * np.tanh(cc)
+        np.testing.assert_allclose(y.numpy()[:, -1], hh, rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_gru(self):
+        gru = nn.GRU(3, 4, direction="bidirect")
+        x = paddle.to_tensor(np.random.rand(2, 5, 3).astype(np.float32))
+        y, h = gru(x)
+        assert y.shape == [2, 5, 8] and h.shape == [2, 2, 4]
+
+
+class TestTransformer:
+    def test_encoder_shapes(self):
+        enc_layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        x = paddle.to_tensor(np.random.rand(2, 6, 16).astype(np.float32))
+        assert enc(x).shape == [2, 6, 16]
+
+    def test_full_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1, num_decoder_layers=1, dim_feedforward=32, dropout=0.0)
+        src = paddle.to_tensor(np.random.rand(2, 5, 16).astype(np.float32))
+        tgt = paddle.to_tensor(np.random.rand(2, 3, 16).astype(np.float32))
+        assert model(src, tgt).shape == [2, 3, 16]
+
+    def test_attention_mask_blocks(self):
+        mha = nn.MultiHeadAttention(8, 2, dropout=0.0)
+        x = paddle.to_tensor(np.random.rand(1, 4, 8).astype(np.float32))
+        mask = np.zeros((1, 1, 4, 4), np.float32)
+        mask[..., 2:] = -1e9  # block attention to positions 2,3
+        out_masked = mha(x, x, x, attn_mask=paddle.to_tensor(mask))
+        x2 = x.numpy().copy()
+        x2[0, 2:] = 0.0  # perturbing masked positions must not change output pos 0..1
+        out_masked2 = mha(paddle.to_tensor(x2), paddle.to_tensor(x2), paddle.to_tensor(x2), attn_mask=paddle.to_tensor(mask))
+        # only compare query positions 0..1 (keys 2.. are masked; values at q>=2 differ)
+        np.testing.assert_allclose(out_masked.numpy()[:, :2], out_masked2.numpy()[:, :2], atol=1e-5)
+
+
+class TestContainersStateDict:
+    def test_sequential_layerlist(self):
+        seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+        assert seq(x).shape == [3, 2]
+        assert len(seq) == 3
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(list(ll.parameters())) == 6
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+        m2.set_state_dict(m1.state_dict())
+        for (_, p1), (_, p2) in zip(m1.named_parameters(), m2.named_parameters()):
+            np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+        # buffers included
+        sd = m1.state_dict()
+        assert any("_mean" in k for k in sd)
+
+    def test_forward_hooks(self):
+        layer = nn.Linear(2, 2)
+        calls = []
+        h = layer.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        layer(paddle.to_tensor(np.zeros((1, 2), np.float32)))
+        assert calls == [1]
+        h.remove()
+        layer(paddle.to_tensor(np.zeros((1, 2), np.float32)))
+        assert calls == [1]
+
+    def test_apply_and_modes(self):
+        model = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        model.eval()
+        assert not model[1].training
+        model.train()
+        assert model[1].training
+
+
+class TestClip:
+    def test_global_norm_clip(self):
+        p1 = paddle.Parameter(np.ones(4, np.float32) * 3)
+        g1 = paddle.to_tensor(np.ones(4, np.float32) * 3)
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p1, g1)])
+        norm = np.linalg.norm(out[0][1].numpy())
+        np.testing.assert_allclose(norm, 1.0, rtol=1e-5)
+
+    def test_clip_by_value(self):
+        p = paddle.Parameter(np.zeros(3, np.float32))
+        g = paddle.to_tensor(np.array([-2.0, 0.5, 2.0], np.float32))
+        out = nn.ClipGradByValue(1.0)([(p, g)])
+        np.testing.assert_allclose(out[0][1].numpy(), [-1.0, 0.5, 1.0])
+
+
+class TestLosses:
+    def test_cross_entropy_matches_manual(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 4, 1])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(loss.item()), ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 4, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels), ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 2], [0, 4]]).mean()
+        np.testing.assert_allclose(float(loss.item()), ref, rtol=1e-5)
+
+    def test_soft_label(self):
+        logits = np.random.rand(3, 4).astype(np.float32)
+        soft = np.random.dirichlet(np.ones(4), 3).astype(np.float32)
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft), soft_label=True)
+        logp = logits - logits.max(-1, keepdims=True)
+        logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+        np.testing.assert_allclose(float(loss.item()), -(soft * logp).sum(-1).mean(), rtol=1e-5)
+
+    def test_bce_with_logits_stable(self):
+        x = np.array([100.0, -100.0], np.float32)
+        y = np.array([1.0, 0.0], np.float32)
+        loss = F.binary_cross_entropy_with_logits(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert float(loss.item()) < 1e-5
+
+    def test_mse_l1(self):
+        a, b = np.random.rand(5).astype(np.float32), np.random.rand(5).astype(np.float32)
+        np.testing.assert_allclose(
+            float(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item()), ((a - b) ** 2).mean(), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item()), np.abs(a - b).mean(), rtol=1e-5
+        )
+
+    def test_kl_div(self):
+        logp = np.log(np.random.dirichlet(np.ones(4), 2)).astype(np.float32)
+        target = np.random.dirichlet(np.ones(4), 2).astype(np.float32)
+        loss = F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(target), reduction="sum")
+        ref = (target * (np.log(target) - logp)).sum()
+        np.testing.assert_allclose(float(loss.item()), ref, rtol=1e-4)
