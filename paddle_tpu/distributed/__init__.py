@@ -41,6 +41,14 @@ def get_backend():
     return "xla"
 
 
+def TCPStore(host="127.0.0.1", port=23456, world_size=None, is_master=False, timeout=30):
+    """Native KV rendezvous store (reference distributed/store/tcp_store.h,
+    C++ impl in runtime_cpp/tcp_store.cc)."""
+    from ..core.native import TCPStore as _Store
+
+    return _Store(host=host, port=port, is_master=is_master, timeout=timeout)
+
+
 def is_initialized():
     from .parallel_env import _initialized
 

@@ -250,12 +250,35 @@ def default_collate_fn(batch):
     return batch
 
 
+def _tree_to_numpy(obj):
+    if isinstance(obj, Tensor):
+        return obj.numpy()
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_numpy(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_numpy(v) for k, v in obj.items()}
+    return obj
+
+
+def _tree_to_tensor(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_tensor(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_tensor(v) for k, v in obj.items()}
+    return obj
+
+
 class _DataLoaderIter:
     """Worker threads → bounded queue → host→device transfer.
 
     Mirrors the reference's _DataLoaderIterMultiProcess + C++ BufferedReader
-    double-buffering (operators/reader/buffered_reader.cc): `prefetch_depth`
-    batches are resident in the queue; device transfer happens on get.
+    double-buffering (operators/reader/buffered_reader.cc): `prefetch_factor`
+    batches stay staged in the queue; device transfer happens on get. When
+    the native runtime is built, the queue is the C++ blocking queue
+    (runtime_cpp/queue.cc) — batches cross as pickled numpy trees and Tensor
+    creation (device transfer) happens only on the consumer thread.
     """
 
     def __init__(self, loader):
@@ -264,11 +287,20 @@ class _DataLoaderIter:
         self.num_workers = loader.num_workers
         self.collate_fn = loader.collate_fn or default_collate_fn
         self.done = False
+        self.native_q = None
         if self.num_workers > 0:
-            self.queue: _queue.Queue = _queue.Queue(maxsize=max(2, loader.prefetch_factor))
+            cap = max(2, loader.prefetch_factor)
+            if loader.use_buffer_reader:
+                try:
+                    from ..core.native import NativeQueue
+
+                    self.native_q = NativeQueue(cap)
+                except Exception:
+                    self.native_q = None
+            if self.native_q is None:
+                self.queue: _queue.Queue = _queue.Queue(maxsize=cap)
             self.index_queue: _queue.Queue = _queue.Queue()
             self.n_pending = 0
-            self.lock = threading.Lock()
             for indices in self.batch_sampler_iter:
                 self.index_queue.put(indices)
                 self.n_pending += 1
@@ -279,22 +311,32 @@ class _DataLoaderIter:
                 self.workers.append(t)
             self.n_received = 0
 
-    def _fetch(self, indices):
+    def _fetch(self, indices, numpy_only=False):
         ds = self.loader.dataset
         if isinstance(ds, IterableDataset):
             raise RuntimeError("use _IterableIter")
-        return self.collate_fn([ds[i] for i in indices])
+        batch = self.collate_fn([ds[i] for i in indices])
+        return _tree_to_numpy(batch) if numpy_only else batch
 
     def _worker_loop(self):
+        import pickle
+
         while True:
             try:
                 indices = self.index_queue.get_nowait()
             except _queue.Empty:
                 return
             try:
-                self.queue.put(("ok", self._fetch(indices)))
+                if self.native_q is not None:
+                    payload = pickle.dumps(("ok", self._fetch(indices, numpy_only=True)), protocol=4)
+                    self.native_q.push(payload)
+                else:
+                    self.queue.put(("ok", self._fetch(indices)))
             except Exception as e:  # surface worker errors like the reference
-                self.queue.put(("err", e))
+                if self.native_q is not None:
+                    self.native_q.push(pickle.dumps(("err", e), protocol=4))
+                else:
+                    self.queue.put(("err", e))
 
     def __iter__(self):
         return self
@@ -306,11 +348,23 @@ class _DataLoaderIter:
         else:
             if self.n_received >= self.n_pending:
                 raise StopIteration
-            kind, payload = self.queue.get()
-            self.n_received += 1
-            if kind == "err":
-                raise payload
-            batch = payload
+            if self.native_q is not None:
+                import pickle
+
+                raw = self.native_q.pop()
+                if raw is None:
+                    raise StopIteration
+                kind, payload = pickle.loads(raw)
+                self.n_received += 1
+                if kind == "err":
+                    raise payload
+                batch = _tree_to_tensor(payload)
+            else:
+                kind, payload = self.queue.get()
+                self.n_received += 1
+                if kind == "err":
+                    raise payload
+                batch = payload
         if self.loader.return_list and isinstance(batch, (list, tuple)):
             return list(batch)
         return batch
@@ -362,6 +416,7 @@ class DataLoader:
         self.collate_fn = collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last

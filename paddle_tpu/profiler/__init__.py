@@ -44,9 +44,29 @@ class _Event:
 _events: List[_Event] = []
 _enabled = False
 
+# Native host recorder (runtime_cpp/trace.cc) when built — GIL-cheap record.
+_native = None
+_native_rec = None
+
+
+def _native_recorder():
+    global _native, _native_rec
+    if _native_rec is not None:
+        return _native_rec
+    try:
+        from ..core.native import lib
+
+        _native = lib()
+        if _native is not None:
+            _native_rec = _native.ptt_create(1 << 16)
+    except Exception:
+        _native = None
+    return _native_rec
+
 
 class RecordEvent:
-    """Reference: platform/profiler.h RecordEvent push/pop."""
+    """Reference: platform/profiler.h RecordEvent push/pop. Events land in
+    the C++ ring buffer when the native runtime is built."""
 
     def __init__(self, name, event_type=None):
         self.name = name
@@ -57,7 +77,12 @@ class RecordEvent:
 
     def end(self):
         if _enabled and self._t0 is not None:
-            _events.append(_Event(self.name, self._t0, time.perf_counter_ns()))
+            t1 = time.perf_counter_ns()
+            rec = _native_recorder()
+            if rec is not None:
+                nid = _native.ptt_intern(rec, self.name.encode())
+                _native.ptt_record(rec, nid, 0, self._t0, t1)
+            _events.append(_Event(self.name, self._t0, t1))
 
     def __enter__(self):
         self.begin()

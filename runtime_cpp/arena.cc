@@ -1,0 +1,97 @@
+// Host staging arena — aligned slab allocator with freelist reuse.
+// TPU-native stand-in for the reference's host allocators
+// (paddle/fluid/memory/allocation/auto_growth_best_fit_allocator.h best-fit
+// with growth; pinned allocator for H2D staging): batches are collated into
+// arena slabs (64-byte aligned, madvise-friendly) so repeated steps reuse
+// identical-size buffers without malloc churn before PJRT H2D transfer.
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+class Arena {
+ public:
+  explicit Arena(size_t align) : align_(align) {}
+
+  ~Arena() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : free_)
+      for (void* p : kv.second) std::free(p);
+    for (auto& kv : live_) std::free(kv.first);
+  }
+
+  void* Alloc(size_t n) {
+    size_t rounded = RoundUp(n);
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = free_.find(rounded);
+    if (it != free_.end() && !it->second.empty()) {
+      void* p = it->second.back();
+      it->second.pop_back();
+      live_[p] = rounded;
+      reused_++;
+      return p;
+    }
+    void* p = nullptr;
+    if (posix_memalign(&p, align_, rounded) != 0) return nullptr;
+    live_[p] = rounded;
+    allocated_ += rounded;
+    return p;
+  }
+
+  void Free(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = live_.find(p);
+    if (it == live_.end()) return;
+    free_[it->second].push_back(p);
+    live_.erase(it);
+  }
+
+  int64_t BytesAllocated() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int64_t>(allocated_);
+  }
+
+  int64_t ReuseCount() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int64_t>(reused_);
+  }
+
+ private:
+  size_t RoundUp(size_t n) {
+    // size-class rounding: next power of two above 4KiB, else page-rounded
+    size_t page = 4096;
+    if (n <= page) return page;
+    size_t p = page;
+    while (p < n) p <<= 1;
+    return p;
+  }
+
+  size_t align_;
+  std::mutex mu_;
+  std::map<size_t, std::vector<void*>> free_;
+  std::map<void*, size_t> live_;
+  size_t allocated_ = 0;
+  size_t reused_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pta_create(int64_t align) { return new Arena(static_cast<size_t>(align)); }
+
+void pta_destroy(void* a) { delete static_cast<Arena*>(a); }
+
+void* pta_alloc(void* a, int64_t n) { return static_cast<Arena*>(a)->Alloc(static_cast<size_t>(n)); }
+
+void pta_free(void* a, void* p) { static_cast<Arena*>(a)->Free(p); }
+
+int64_t pta_bytes(void* a) { return static_cast<Arena*>(a)->BytesAllocated(); }
+
+int64_t pta_reused(void* a) { return static_cast<Arena*>(a)->ReuseCount(); }
+
+}  // extern "C"

@@ -1,0 +1,100 @@
+// Bounded MPMC blocking queue of byte buffers — the C++ core of the data
+// pipeline. TPU-native equivalent of the reference's feed-path queue
+// (paddle/fluid/operators/reader/blocking_queue.h,
+//  lod_tensor_blocking_queue.h) and the BufferedReader's staging slots
+// (operators/reader/buffered_reader.cc): producers (dataloader workers) copy
+// collated batches in without holding the GIL; the consumer pops and hands
+// the buffer to PJRT for async H2D.
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Buffer {
+  std::vector<uint8_t> data;
+};
+
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(size_t capacity) : capacity_(capacity) {}
+
+  // returns 0 on success, -1 if closed
+  int Push(const uint8_t* bytes, size_t n) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return closed_ || q_.size() < capacity_; });
+    if (closed_) return -1;
+    q_.emplace_back();
+    q_.back().data.assign(bytes, bytes + n);
+    not_empty_.notify_one();
+    return 0;
+  }
+
+  // returns size of popped buffer, 0 if closed-and-empty. Two-phase: Pop
+  // reserves, CopyOut copies into caller storage, Release frees.
+  int64_t PopSize() {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    if (q_.empty()) return 0;  // closed and drained
+    return static_cast<int64_t>(q_.front().data.size());
+  }
+
+  int64_t PopInto(uint8_t* out, size_t out_cap) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    if (q_.empty()) return 0;
+    Buffer b = std::move(q_.front());
+    q_.pop_front();
+    not_full_.notify_one();
+    size_t n = b.data.size();
+    if (n > out_cap) return -1;
+    std::memcpy(out, b.data.data(), n);
+    return static_cast<int64_t>(n);
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  size_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+ private:
+  size_t capacity_;
+  bool closed_ = false;
+  std::deque<Buffer> q_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptq_create(int64_t capacity) { return new BlockingQueue(static_cast<size_t>(capacity)); }
+
+int ptq_push(void* q, const uint8_t* bytes, int64_t n) {
+  return static_cast<BlockingQueue*>(q)->Push(bytes, static_cast<size_t>(n));
+}
+
+int64_t ptq_pop_size(void* q) { return static_cast<BlockingQueue*>(q)->PopSize(); }
+
+int64_t ptq_pop_into(void* q, uint8_t* out, int64_t cap) {
+  return static_cast<BlockingQueue*>(q)->PopInto(out, static_cast<size_t>(cap));
+}
+
+void ptq_close(void* q) { static_cast<BlockingQueue*>(q)->Close(); }
+
+int64_t ptq_size(void* q) { return static_cast<int64_t>(static_cast<BlockingQueue*>(q)->Size()); }
+
+void ptq_destroy(void* q) { delete static_cast<BlockingQueue*>(q); }
+
+}  // extern "C"

@@ -1,0 +1,263 @@
+// TCPStore — key/value rendezvous over TCP.
+// TPU-native equivalent of the reference's torch-style store
+// (paddle/fluid/distributed/store/tcp_store.{h,cc}, tcp_utils.cc) used for
+// multi-host bootstrap; replaces the comm-id plumbing
+// (platform/gen_comm_id_helper.cc) for anything the JAX coordination service
+// doesn't cover (e.g. user-level barriers, elastic membership).
+//
+// Protocol (all little-endian):
+//   request : op:u8 | klen:u32 | key | vlen:u32 | value
+//   ops     : 0=SET 1=GET 2=ADD(value=i64 delta) 3=WAIT 4=DELETE
+//   response: status:u8 (0 ok, 1 missing) | vlen:u32 | value
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {}
+
+  bool Start() {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) return false;
+    if (::listen(fd_, 128) != 0) return false;
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void Stop() {
+    stopping_ = true;
+    if (fd_ >= 0) {
+      ::shutdown(fd_, SHUT_RDWR);
+      ::close(fd_);
+      fd_ = -1;
+    }
+    cv_.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : handlers_)
+      if (t.joinable()) t.join();
+  }
+
+  ~StoreServer() { Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_) {
+      int cfd = ::accept(fd_, nullptr, nullptr);
+      if (cfd < 0) break;
+      handlers_.emplace_back([this, cfd] { Handle(cfd); });
+    }
+  }
+
+  void Handle(int cfd) {
+    int one = 1;
+    ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (!stopping_) {
+      uint8_t op;
+      uint32_t klen, vlen;
+      if (!ReadFull(cfd, &op, 1) || !ReadFull(cfd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !ReadFull(cfd, key.data(), klen)) break;
+      if (!ReadFull(cfd, &vlen, 4)) break;
+      std::string val(vlen, '\0');
+      if (vlen && !ReadFull(cfd, val.data(), vlen)) break;
+
+      uint8_t status = 0;
+      std::string out;
+      switch (op) {
+        case 0: {  // SET
+          std::lock_guard<std::mutex> lk(mu_);
+          kv_[key] = val;
+          cv_.notify_all();
+          break;
+        }
+        case 1: {  // GET
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = kv_.find(key);
+          if (it == kv_.end()) {
+            status = 1;
+          } else {
+            out = it->second;
+          }
+          break;
+        }
+        case 2: {  // ADD
+          int64_t delta = 0;
+          std::memcpy(&delta, val.data(), std::min<size_t>(8, val.size()));
+          std::lock_guard<std::mutex> lk(mu_);
+          int64_t cur = 0;
+          auto it = kv_.find(key);
+          if (it != kv_.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::string enc(8, '\0');
+          std::memcpy(enc.data(), &cur, 8);
+          kv_[key] = enc;
+          out = enc;
+          cv_.notify_all();
+          break;
+        }
+        case 3: {  // WAIT (blocks until key exists)
+          std::unique_lock<std::mutex> lk(mu_);
+          cv_.wait(lk, [&] { return stopping_ || kv_.count(key) > 0; });
+          if (stopping_) {
+            status = 1;
+          } else {
+            out = kv_[key];
+          }
+          break;
+        }
+        case 4: {  // DELETE
+          std::lock_guard<std::mutex> lk(mu_);
+          kv_.erase(key);
+          break;
+        }
+        default:
+          status = 1;
+      }
+      uint32_t olen = static_cast<uint32_t>(out.size());
+      if (!WriteFull(cfd, &status, 1) || !WriteFull(cfd, &olen, 4)) break;
+      if (olen && !WriteFull(cfd, out.data(), olen)) break;
+    }
+    ::close(cfd);
+  }
+
+  int port_;
+  int fd_ = -1;
+  volatile bool stopping_ = false;
+  std::thread accept_thread_;
+  std::vector<std::thread> handlers_;
+  std::map<std::string, std::string> kv_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+};
+
+class StoreClient {
+ public:
+  bool Connect(const char* host, int port, int timeout_ms) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return false;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return false;
+    // retry-connect within timeout (server may start later)
+    int waited = 0;
+    while (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+      ::close(fd_);
+      if (waited >= timeout_ms) return false;
+      ::usleep(100 * 1000);
+      waited += 100;
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    }
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return true;
+  }
+
+  // returns status(0/1), fills out
+  int Request(uint8_t op, const std::string& key, const std::string& val, std::string* out) {
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    uint32_t vlen = static_cast<uint32_t>(val.size());
+    if (!WriteFull(fd_, &op, 1) || !WriteFull(fd_, &klen, 4)) return -1;
+    if (klen && !WriteFull(fd_, key.data(), klen)) return -1;
+    if (!WriteFull(fd_, &vlen, 4)) return -1;
+    if (vlen && !WriteFull(fd_, val.data(), vlen)) return -1;
+    uint8_t status;
+    uint32_t olen;
+    if (!ReadFull(fd_, &status, 1) || !ReadFull(fd_, &olen, 4)) return -1;
+    out->resize(olen);
+    if (olen && !ReadFull(fd_, out->data(), olen)) return -1;
+    return status;
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+ private:
+  int fd_ = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pts_server_create(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->Start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void pts_server_destroy(void* s) { delete static_cast<StoreServer*>(s); }
+
+void* pts_client_create(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient();
+  if (!c->Connect(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pts_client_destroy(void* c) { delete static_cast<StoreClient*>(c); }
+
+// returns status; out buffer must hold out_cap; actual length in *out_len
+int pts_request(void* c, int op, const char* key, const uint8_t* val, int64_t vlen,
+                uint8_t* out, int64_t out_cap, int64_t* out_len) {
+  std::string o;
+  int status = static_cast<StoreClient*>(c)->Request(
+      static_cast<uint8_t>(op), key, std::string(reinterpret_cast<const char*>(val), static_cast<size_t>(vlen)), &o);
+  if (status < 0) return -1;
+  if (static_cast<int64_t>(o.size()) > out_cap) return -2;
+  std::memcpy(out, o.data(), o.size());
+  *out_len = static_cast<int64_t>(o.size());
+  return status;
+}
+
+}  // extern "C"

@@ -1,0 +1,102 @@
+// Host event recorder — fixed-size ring of (name_id, t_start, t_end, tid).
+// TPU-native equivalent of the reference's HostTracer / HostEventRecorder
+// (paddle/fluid/platform/profiler/host_event_recorder.h): RecordEvent
+// push/pop with nanosecond timestamps, drained by the Python profiler into
+// chrome-trace JSON. Lock-free per-slot via an atomic cursor.
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Event {
+  uint32_t name_id;
+  uint32_t tid;
+  uint64_t t0;
+  uint64_t t1;
+};
+
+class Recorder {
+ public:
+  explicit Recorder(size_t capacity) : events_(capacity), cursor_(0) {}
+
+  uint32_t InternName(const char* name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = name_ids_.find(name);
+    if (it != name_ids_.end()) return it->second;
+    uint32_t id = static_cast<uint32_t>(names_.size());
+    names_.emplace_back(name);
+    name_ids_[name] = id;
+    return id;
+  }
+
+  void Record(uint32_t name_id, uint32_t tid, uint64_t t0, uint64_t t1) {
+    size_t i = cursor_.fetch_add(1, std::memory_order_relaxed) % events_.size();
+    events_[i] = Event{name_id, tid, t0, t1};
+  }
+
+  // Copy out up to n events (most recent wraparound window); returns count.
+  int64_t Drain(Event* out, size_t n) {
+    size_t total = cursor_.load(std::memory_order_relaxed);
+    size_t avail = total < events_.size() ? total : events_.size();
+    size_t count = avail < n ? avail : n;
+    for (size_t k = 0; k < count; ++k) out[k] = events_[(total - avail + k) % events_.size()];
+    return static_cast<int64_t>(count);
+  }
+
+  const char* Name(uint32_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return id < names_.size() ? names_[id].c_str() : "";
+  }
+
+  void Reset() { cursor_.store(0); }
+
+ private:
+  std::vector<Event> events_;
+  std::atomic<size_t> cursor_;
+  std::vector<std::string> names_;
+  std::unordered_map<std::string, uint32_t> name_ids_;
+  std::mutex mu_;
+};
+
+uint64_t NowNs() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptt_create(int64_t capacity) { return new Recorder(static_cast<size_t>(capacity)); }
+
+void ptt_destroy(void* r) { delete static_cast<Recorder*>(r); }
+
+uint32_t ptt_intern(void* r, const char* name) { return static_cast<Recorder*>(r)->InternName(name); }
+
+uint64_t ptt_now_ns() { return NowNs(); }
+
+void ptt_record(void* r, uint32_t name_id, uint32_t tid, uint64_t t0, uint64_t t1) {
+  static_cast<Recorder*>(r)->Record(name_id, tid, t0, t1);
+}
+
+// out layout per event: name_id u32 | tid u32 | t0 u64 | t1 u64 (24 bytes)
+int64_t ptt_drain(void* r, uint8_t* out, int64_t max_events) {
+  std::vector<Event> tmp(static_cast<size_t>(max_events));
+  int64_t n = static_cast<Recorder*>(r)->Drain(tmp.data(), tmp.size());
+  std::memcpy(out, tmp.data(), static_cast<size_t>(n) * sizeof(Event));
+  return n;
+}
+
+const char* ptt_name(void* r, uint32_t id) { return static_cast<Recorder*>(r)->Name(id); }
+
+void ptt_reset(void* r) { static_cast<Recorder*>(r)->Reset(); }
+
+}  // extern "C"

@@ -1,0 +1,150 @@
+"""Native C++ runtime tests (queue / TCPStore / trace / arena)."""
+import ctypes
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+
+pytestmark = pytest.mark.skipif(native.lib() is None, reason="native runtime not built")
+
+
+class TestNativeQueue:
+    def test_fifo_and_close(self):
+        q = native.NativeQueue(4)
+        for i in range(3):
+            q.push(bytes([i]))
+        assert len(q) == 3
+        assert q.pop() == b"\x00"
+        q.close()
+        assert q.pop() == b"\x01"
+        assert q.pop() == b"\x02"
+        assert q.pop() is None  # drained + closed
+
+    def test_blocking_producer_consumer(self):
+        q = native.NativeQueue(2)
+        received = []
+
+        def consumer():
+            while True:
+                b = q.pop()
+                if b is None:
+                    return
+                received.append(b)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        for i in range(20):
+            assert q.push(np.full(100, i, np.uint8).tobytes())
+        q.close()
+        t.join(timeout=10)
+        assert len(received) == 20
+        assert received[7][0] == 7
+
+    def test_push_after_close_fails(self):
+        q = native.NativeQueue(2)
+        q.close()
+        assert not q.push(b"x")
+
+
+class TestTCPStore:
+    def test_set_get_add_wait(self):
+        master = native.TCPStore(port=29911, is_master=True)
+        worker = native.TCPStore(port=29911)
+        try:
+            master.set("a", b"1")
+            assert worker.get("a") == b"1"
+            assert worker.get("missing") is None
+            assert worker.add("n", 3) == 3
+            assert master.add("n", -1) == 2
+            got = []
+            t = threading.Thread(target=lambda: got.append(worker.wait("later")))
+            t.start()
+            time.sleep(0.1)
+            master.set("later", b"v")
+            t.join(timeout=5)
+            assert got == [b"v"]
+            master.delete_key("a")
+            assert worker.get("a") is None
+        finally:
+            worker.close()
+            master.close()
+
+    def test_barrier_pattern(self):
+        """Rendezvous barrier: N participants count up then wait."""
+        master = native.TCPStore(port=29912, is_master=True)
+        clients = [native.TCPStore(port=29912) for _ in range(3)]
+        try:
+            def participant(c, i):
+                n = c.add("barrier", 1)
+                if n == 3:
+                    c.set("barrier_done", b"1")
+                c.wait("barrier_done")
+
+            ts = [threading.Thread(target=participant, args=(c, i)) for i, c in enumerate(clients)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=5)
+            assert all(not t.is_alive() for t in ts)
+        finally:
+            for c in clients:
+                c.close()
+            master.close()
+
+
+class TestTraceArena:
+    def test_trace_records(self):
+        L = native.lib()
+        r = L.ptt_create(128)
+        nid = L.ptt_intern(r, b"matmul")
+        assert L.ptt_intern(r, b"matmul") == nid  # interned
+        t0 = L.ptt_now_ns()
+        L.ptt_record(r, nid, 1, t0, t0 + 500)
+        buf = ctypes.create_string_buffer(24 * 8)
+        n = L.ptt_drain(r, buf, 8)
+        assert n == 1
+        assert L.ptt_name(r, nid) == b"matmul"
+        L.ptt_destroy(r)
+
+    def test_arena_reuse(self):
+        L = native.lib()
+        a = L.pta_create(64)
+        p = L.pta_alloc(a, 10_000)
+        assert p % 64 == 0
+        L.pta_free(a, p)
+        p2 = L.pta_alloc(a, 12_000)  # same 16KiB size class → reused
+        assert p2 == p
+        assert L.pta_reused(a) == 1
+        L.pta_destroy(a)
+
+    def test_profiler_uses_native(self):
+        import paddle_tpu.profiler as prof
+
+        p = prof.Profiler(timer_only=True)
+        p.start()
+        with prof.RecordEvent("test_op"):
+            time.sleep(0.001)
+        p.stop()
+        assert "test_op" in p.summary()
+
+
+class TestDataLoaderNativePath:
+    def test_native_queue_loader_matches_serial(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                return np.full((4,), i, np.float32), np.int64(i)
+
+        serial = sorted(float(b[1].numpy()[0]) for b in DataLoader(DS(), batch_size=3))
+        native_batches = list(DataLoader(DS(), batch_size=3, num_workers=2))
+        assert len(native_batches) == 4
+        got = sorted(float(b[1].numpy()[0]) for b in native_batches)
+        assert got == serial
