@@ -16,11 +16,36 @@ import jax.numpy as jnp
 from ...core.dispatch import as_tensor, eager_call
 
 
+def _flash_eligible(q, k, is_causal, attn_mask, dropout_p, training):
+    if not is_causal or attn_mask is not None:
+        return False
+    if dropout_p and training:
+        return False
+    d = q.shape[-1]
+    if d % 8 != 0 or d > 256:
+        return False
+    if q.shape[1] < 128 or k.shape[1] % 128 != 0:
+        return False  # tiny sequences: XLA fused path is already fine
+    return True
+
+
 def scaled_dot_product_attention(
     query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
 ):
-    """q,k,v: (B, T, H, D) — paddle convention. Returns (B, T, H, D)."""
+    """q,k,v: (B, T, H, D) — paddle convention. Returns (B, T, H, D).
+
+    Causal/no-mask/no-dropout calls route to the Pallas flash kernel
+    (blockwise online softmax, no T×T materialization); everything else uses
+    the XLA fused formulation.
+    """
     q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    if _flash_eligible(q, k, is_causal, attn_mask, dropout_p, training):
+        try:
+            from ...ops.pallas.flash_attention import flash_attention_tpu
+
+            return flash_attention_tpu(q, k, v, causal=True)
+        except Exception:
+            pass
     inputs = [q, k, v]
     has_mask = attn_mask is not None
     if has_mask:
