@@ -24,8 +24,16 @@ def _flash_eligible(q, k, is_causal, attn_mask, dropout_p, training):
     d = q.shape[-1]
     if d % 8 != 0 or d > 256:
         return False
-    if q.shape[1] < 128 or k.shape[1] % 128 != 0:
-        return False  # tiny sequences: XLA fused path is already fine
+    if q.shape[1] < 512 or k.shape[1] % 128 != 0:
+        return False  # short sequences: XLA's fused exact path measured faster
+    # The backward kernels keep one full (T, D) operand pair resident in VMEM
+    # (K/V for dq, Q/dO for dkv); bound it so jit-compile can't die on a
+    # Mosaic allocation error with no fallback (~16 MB VMEM on v5e).
+    esize = 2 if q.dtype in ("bfloat16", jnp.bfloat16) else 4
+    if k.shape[1] * d * esize > 4 * 1024 * 1024:
+        return False
+    if jax.devices()[0].platform == "cpu":
+        return False  # interpret-mode pallas is orders slower; XLA exact wins
     return True
 
 
@@ -50,39 +58,53 @@ def scaled_dot_product_attention(
     has_mask = attn_mask is not None
     if has_mask:
         inputs.append(as_tensor(attn_mask))
+    use_dropout = bool(dropout_p) and training
+    if use_dropout:
+        # keep-mask as a data input (same pattern as functional.dropout — a
+        # closure-captured key would recompile the dispatch cache every step)
+        from ...core import random as random_state
+        from ...core.tensor import Tensor
 
-    def fn(q, k, v, *m, is_causal=False, has_mask=False):
+        shape = (q.shape[0], q.shape[2], q.shape[1], k.shape[1])
+        keep = jax.random.bernoulli(random_state.next_key(), 1.0 - float(dropout_p), shape)
+        inputs.append(Tensor(keep))
+
+    def fn(q, k, v, *rest, is_causal=False, has_mask=False, dropout_p=0.0):
         # (B, T, H, D) → (B, H, T, D)
         qh = jnp.swapaxes(q, 1, 2)
         kh = jnp.swapaxes(k, 1, 2)
         vh = jnp.swapaxes(v, 1, 2)
         scale = 1.0 / math.sqrt(qh.shape[-1])
         scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        idx = 0
         if has_mask:
-            scores = scores + m[0]
+            scores = scores + rest[idx]
+            idx += 1
         if is_causal:
             tq, tk = scores.shape[-2], scores.shape[-1]
             causal = jnp.tril(jnp.ones((tq, tk), bool))
             scores = jnp.where(causal, scores, jnp.asarray(-1e30, scores.dtype))
         probs = jax.nn.softmax(scores, axis=-1)
+        if dropout_p:
+            probs = probs * rest[idx].astype(probs.dtype) / (1.0 - dropout_p)
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
         return jnp.swapaxes(out, 1, 2)
 
     return eager_call(
         "scaled_dot_product_attention", fn, inputs,
-        {"is_causal": is_causal, "has_mask": has_mask},
+        {"is_causal": is_causal, "has_mask": has_mask,
+         "dropout_p": float(dropout_p) if use_dropout else 0.0},
     )
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, name=None):
-    """Flash attention — Pallas TPU kernel when on TPU, XLA fallback otherwise."""
-    q = as_tensor(query)
-    try:
-        from ...ops.pallas.flash_attention import flash_attention_tpu
+    """Flash attention — Pallas TPU kernel when eligible, XLA exact otherwise."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    if _flash_eligible(q, k, causal, None, dropout, True):
+        try:
+            from ...ops.pallas.flash_attention import flash_attention_tpu
 
-        out = flash_attention_tpu(q, as_tensor(key), as_tensor(value), causal=causal)
-    except Exception:
-        out = scaled_dot_product_attention(query, key, value, is_causal=causal)
-    if return_softmax:
-        return out, None
-    return out, None
+            return flash_attention_tpu(q, k, v, causal=causal), None
+        except Exception:
+            pass
+    return scaled_dot_product_attention(q, k, v, is_causal=causal, dropout_p=dropout), None

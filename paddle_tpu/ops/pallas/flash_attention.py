@@ -29,13 +29,25 @@ except Exception:  # pragma: no cover
 
 _NEG_INF = -1e30
 
+# The framework pins jax_default_matmul_precision="highest" (fp32 parity for
+# f32 tests); Mosaic rejects fp32 contract precision on bf16 operands, and the
+# MXU's native mode is bf16×bf16→f32 anyway. For f32 inputs keep HIGHEST
+# (true fp32 passes — the pre-rework accuracy); dtype is known at trace time.
+def _prec(dtype):
+    return (
+        jax.lax.Precision.HIGHEST
+        if dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float, t_kv: int):
     # q_ref: (1, BQ, D); k_ref/v_ref: (1, T, D); o_ref: (1, BQ, D); lse_ref: (1, BQ, 1)
     iq = pl.program_id(1)
     bq = q_ref.shape[1]
     d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # (BQ, D)
+    q = q_ref[0]  # (BQ, D) — keep input dtype: MXU does bf16×bf16→f32
+    _PREC = _prec(q.dtype)
 
     m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
@@ -45,11 +57,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (BQ, BK)
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        ) * jnp.float32(scale)  # (BQ, BK) f32 accum
         if causal:
             q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
@@ -60,7 +72,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
         return m_new, l_new, acc_new
 
@@ -124,61 +137,165 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
-    # Backward from saved lse: p = exp(q·kᵀ·scale − lse). Chunked over query
-    # blocks (lax.map) so peak memory is BQ×T, not T×T.
-    q, k, v, out, lse = res
-    lse = lse[..., 0]  # (BH, T)
-    bh, t, d = q.shape
-    scale = 1.0 / math.sqrt(d)
-    qf, kf, vf = q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
-    dof, of = do.astype(jnp.float32), out.astype(jnp.float32)
-    delta = jnp.sum(dof * of, axis=-1)  # (BH, T)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k: int, causal: bool, scale: float, t_kv: int):
+    # q/do/dq: (1, BQ, D); k/v: (1, T, D); lse/delta: (1, BQ, 1)
+    iq = pl.program_id(1)
+    bq = q_ref.shape[1]
+    q = q_ref[0]  # (BQ, D)
+    _PREC = _prec(q.dtype)
+    do = do_ref[0]
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    n_kb = t_kv // block_k
 
-    n_q = t // block_q
-    q_c = qf.reshape(bh, n_q, block_q, d)
-    do_c = dof.reshape(bh, n_q, block_q, d)
-    lse_c = lse.reshape(bh, n_q, block_q)
-    delta_c = delta.reshape(bh, n_q, block_q)
-
-    q_pos_base = jnp.arange(block_q)
-    k_pos = jnp.arange(t)
-
-    def per_qblock(args):
-        qb, dob, lseb, deltab, iq = args
-        s = jnp.einsum("bqd,bkd->bqk", qb, kf) * scale
+    def body(kb, acc):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        ) * jnp.float32(scale)  # (BQ, BK)
         if causal:
-            qpos = iq * block_q + q_pos_base
-            mask = qpos[None, :, None] >= k_pos[None, None, :]
-            s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lseb[..., None])  # (BH, BQ, T)
-        dv_b = jnp.einsum("bqk,bqd->bkd", p, dob)
-        dp = jnp.einsum("bqd,bkd->bqk", dob, vf)
-        ds = p * (dp - deltab[..., None]) * scale
-        dq_b = jnp.einsum("bqk,bkd->bqd", ds, kf)
-        dk_b = jnp.einsum("bqk,bqd->bkd", ds, qb)
-        return dq_b, dk_b, dv_b
+            q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(_NEG_INF))
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        )  # (BQ, BK)
+        ds = p * (dp - delta[:, None])
+        return acc + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )
 
-    dq_c, dk_parts, dv_parts = jax.lax.map(
-        per_qblock,
-        (
-            jnp.moveaxis(q_c, 1, 0),
-            jnp.moveaxis(do_c, 1, 0),
-            jnp.moveaxis(lse_c, 1, 0),
-            jnp.moveaxis(delta_c, 1, 0),
-            jnp.arange(n_q),
-        ),
+    if causal and bq == block_k:
+        last_kb = jnp.minimum(iq + 1, n_kb)
+    else:
+        last_kb = n_kb
+    acc = jax.lax.fori_loop(0, last_kb, body, jnp.zeros((bq, q_ref.shape[2]), jnp.float32))
+    dq_ref[0] = (acc * jnp.float32(scale)).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float, t_q: int):
+    # k/v/dk/dv: (1, BK, D); q/do: (1, T, D); lse/delta: (1, T, 1)
+    ik = pl.program_id(1)
+    bk = k_ref.shape[1]
+    d = k_ref.shape[2]
+    k_blk = k_ref[0]  # (BK, D)
+    _PREC = _prec(k_blk.dtype)
+    v_blk = v_ref[0]
+    n_qb = t_q // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        qq = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0]
+        s = jax.lax.dot_general(
+            qq, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        ) * jnp.float32(scale)  # (BQ, BK)
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(_NEG_INF))
+        p = jnp.exp(s - lse[:, None])  # (BQ, BK)
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )  # (BK, D)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        )  # (BQ, BK)
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)
+        dk = dk + jax.lax.dot_general(
+            ds.astype(qq.dtype), qq, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )  # (BK, D)
+        return dk, dv
+
+    if causal and bk == block_q:
+        first_qb = ik  # q blocks strictly before this k block are fully masked
+    else:
+        first_qb = 0
+    dk, dv = jax.lax.fori_loop(
+        first_qb, n_qb, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
     )
-    dq = jnp.moveaxis(dq_c, 0, 1).reshape(bh, t, d).astype(q.dtype)
-    dk = jnp.sum(dk_parts, axis=0).astype(k.dtype)
-    dv = jnp.sum(dv_parts, axis=0).astype(v.dtype)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
+    bh, t, d = q.shape
+    t_kv = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)  # (BH, T, 1)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale, t_kv=t_kv),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale, t_q=t),
+        grid=(bh, t_kv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, t, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, t, 1), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(k, v, q, do, lse, delta)
     return dq, dk, dv
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
+    # Pallas backward: recompute p = exp(q·kᵀ·scale − lse) block-wise in VMEM.
+    # Two kernels — dq streams K/V blocks per query block; dk/dv streams Q/dO
+    # blocks per key block (causal lower bound skips fully-masked blocks).
+    # No (BQ,T) score block or (n_q,BH,T,D) intermediate ever reaches HBM.
+    q, k, v, out, lse = res
+    with jax.enable_x64(False):
+        return _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention_array(q, k, v, causal=False, block_q=128, block_k=128, interpret=None):
+def _pick_block(limit, t):
+    # Largest power-of-two block ≤ limit that divides t — avoids zero-padding
+    # (a 512-block on T=640 would pad ~60% wasted FLOPs). 512 measured fastest
+    # on v5e (vs 128: 1.55× at T=1024, 3.2× at T=8192).
+    for b in (limit, 256, 128):
+        if b <= limit and t % b == 0 and b % 8 == 0:
+            return b
+    return 128  # no aligned divisor: 128 block + zero-padding
+
+
+def flash_attention_array(q, k, v, causal=False, block_q=512, block_k=512, interpret=None):
     """Pure-array flash attention. q,k,v: (B, T, H, D) → (B, T, H, D)."""
     if not _HAS_PALLAS:
         raise RuntimeError("pallas unavailable")
@@ -186,8 +303,8 @@ def flash_attention_array(q, k, v, causal=False, block_q=128, block_k=128, inter
         interpret = jax.devices()[0].platform == "cpu"
     b, t, h, d = q.shape
     t_kv = k.shape[1]
-    block_q = min(block_q, t)
-    block_k = min(block_k, t_kv)
+    block_q = _pick_block(min(block_q, t), t)
+    block_k = _pick_block(min(block_k, t_kv), t_kv)
 
     def to_bh(x):
         return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
