@@ -135,6 +135,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = set()  # id(optimizer) already unscaled this step
 
     def scale(self, var):
         if not self._enable:
@@ -144,6 +145,11 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        if id(optimizer) in self._unscaled:
+            return  # already unscaled this step (e.g. explicit unscale_ for
+            # grad clipping followed by step()) — the reference tracks
+            # OptimizerState.UNSCALED for exactly this
+        self._unscaled.add(id(optimizer))
         found = False
         for p in optimizer._parameter_list or []:
             if p.grad is not None:
@@ -166,6 +172,8 @@ class GradScaler:
             optimizer.step()
 
     def update(self):
+        self._unscaled.clear()  # next iteration may unscale again (even when
+        # dynamic scaling is off — the early return below must not skip this)
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:
@@ -181,6 +189,7 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+        self._unscaled.clear()
 
     def is_enable(self):
         return self._enable

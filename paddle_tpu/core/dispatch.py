@@ -82,6 +82,22 @@ def _get_jitted(fn, attrs):
     return jf
 
 
+def _check_nan_inf(name, outs):
+    # FLAGS_check_nan_inf debug scan — the reference checks every op output
+    # when the flag is set (operator.cc:1171 → nan_inf_utils_detail.cc).
+    # Host-side isfinite forces a device sync per op; that's the documented
+    # cost of the debug mode there too.
+    import jax.numpy as jnp
+
+    for i, o in enumerate(outs):
+        if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating):
+            if not bool(jnp.isfinite(o).all()):
+                raise FloatingPointError(
+                    f"Operator '{name}' output {i} contains NaN or Inf "
+                    f"(FLAGS_check_nan_inf is set)."
+                )
+
+
 def eager_call(
     name: str,
     fn: Callable,
@@ -106,9 +122,15 @@ def eager_call(
         and any(not t.stop_gradient for t in tensor_args)
     )
 
+    from ..framework import flags as _flags
+
+    check_naninf = _flags.flag("FLAGS_check_nan_inf", False)
+
     if not need_grad:
         outs = _get_jitted(fn, attrs)(*arrays)
         single = not isinstance(outs, (tuple, list))
+        if check_naninf:
+            _check_nan_inf(name, (outs,) if single else outs)
         outs_t = [Tensor(o, stop_gradient=True) for o in ((outs,) if single else outs)]
         return outs_t[0] if single else outs_t
 
@@ -158,11 +180,15 @@ def eager_call(
     # Replay info for higher-order grads (create_graph): backward is re-run as
     # a recorded op over the ORIGINAL input tensors so d(grad)/d(input) exists.
     if nondiff_outputs:
-        diff_fn = diff_only
+        # replay must produce ONLY the differentiable outputs (cotangent
+        # structure matches diff_outs): reuse split_fn and drop the aux part
+        diff_fn = lambda *xs: split_fn(*xs)[0]
     else:
         diff_fn = lambda *xs: fn(*xs, **attrs)
     node.replay = (diff_fn, list(tensor_args), multi)
 
+    if check_naninf:
+        _check_nan_inf(name, outs)
     outs_t = []
     refs = [None] * len(out_avals)
     for i, o in enumerate(outs):

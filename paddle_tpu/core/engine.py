@@ -229,6 +229,24 @@ def run_backward(
             else _zeros(shape, dtype)
             for i, (shape, dtype) in enumerate(node.out_avals)
         )
+        # Non-leaf tensor hooks (Tensor.register_hook) transform the cotangent
+        # flowing through the tensor — the reference invokes hooks on any
+        # autograd-tracked tensor, not just leaves.
+        if node.out_tensors is not None:
+            cts = list(cts)
+            for i, ref in enumerate(node.out_tensors):
+                t = ref() if callable(ref) else None
+                if t is not None and t._backward_hooks:
+                    hook_g = cts[i]
+                    for hook in t._backward_hooks:
+                        arg = hook_g if isinstance(hook_g, Tensor) else Tensor(hook_g)
+                        out = hook(arg)
+                        if out is not None:
+                            hook_g = out if create_graph else (
+                                out._data if isinstance(out, Tensor) else out
+                            )
+                    cts[i] = hook_g
+            cts = tuple(cts)
         # Capture cotangents of intermediate tensors produced by this node.
         if node.out_tensors is not None:
             for i, ref in enumerate(node.out_tensors):

@@ -239,21 +239,41 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None, out_split_s
     return t
 
 
+def p2p_permute(tensor, perm, axis_name):
+    """Point-to-point transfer inside shard_map: ``perm`` is a list of
+    (src, dst) pairs over ``axis_name`` — the XLA collective-permute that
+    replaces the reference's send_v2/recv_v2 NCCL ops
+    (paddle/fluid/operators/collective/send_v2_op.cc). Ranks not named as a
+    dst receive zeros, matching collective-permute semantics."""
+    t = as_tensor(tensor)
+    out = lax.ppermute(t._data, axis_name, perm)
+    return Tensor(out)
+
+
+def _p2p_unsupported(name):
+    raise NotImplementedError(
+        f"paddle_tpu.distributed.{name}: host-level eager p2p has no XLA "
+        "equivalent on TPU — p2p is compiler-scheduled. Use p2p_permute "
+        "inside shard_map (pipeline schedules do this; see "
+        "fleet/meta_parallel/pipeline_parallel.py), or all_gather/broadcast "
+        "for host-visible exchange."
+    )
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    """p2p send — inside shard_map lower to ppermute (see parallel/pp_utils)."""
-    return as_tensor(tensor)
+    _p2p_unsupported("send")
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    return as_tensor(tensor)
+    _p2p_unsupported("recv")
 
 
 def isend(tensor, dst=0, group=None):
-    return send(tensor, dst, group)
+    _p2p_unsupported("isend")
 
 
 def irecv(tensor, src=0, group=None):
-    return recv(tensor, src, group)
+    _p2p_unsupported("irecv")
 
 
 def barrier(group=None):

@@ -1,10 +1,11 @@
 """paddle.save / paddle.load.
 
 Reference: ``python/paddle/framework/io.py:553,769`` — pickle-based state
-persistence with a tensor protocol. We serialize Tensors as numpy arrays
-inside a pickle stream; nested dicts/lists (state_dicts, opt states) are
-supported, matching reference semantics. bfloat16 is serialized via a
-dtype-tagged raw-bytes wrapper since numpy lacks native bf16.
+persistence with a tensor protocol. Tensors are serialized as plain,
+self-describing dicts holding numpy arrays (bfloat16 as dtype-tagged raw
+bytes, since numpy lacks native bf16), so checkpoints are readable with
+nothing but pickle+numpy — no framework import required — matching the
+reference's plain numpy-pickle state-dict format.
 """
 from __future__ import annotations
 
@@ -16,20 +17,11 @@ import numpy as np
 
 from ..core.tensor import Tensor, Parameter
 
+_TENSOR_KEY = "__paddle_tpu_tensor__"
+
 
 class _TensorPayload:
-    """Pickle-stable tensor container (handles bfloat16 via raw bytes)."""
-
-    def __init__(self, array: np.ndarray, dtype_name: str, is_param: bool, name: str, stop_gradient: bool = True):
-        self.dtype_name = dtype_name
-        self.is_param = is_param
-        self.name = name
-        self.stop_gradient = stop_gradient
-        if dtype_name == "bfloat16":
-            self.shape = array.shape
-            self.buf = array.tobytes()
-        else:
-            self.array = array
+    """Legacy pickle container — kept so pre-existing checkpoints load."""
 
     def to_tensor(self):
         from ..core import dtype as dtypes
@@ -38,23 +30,38 @@ class _TensorPayload:
             arr = np.frombuffer(self.buf, dtype=dtypes.bfloat16).reshape(self.shape)
         else:
             arr = self.array
-        if self.is_param:
-            t = Parameter(arr, trainable=not self.stop_gradient)
-            t.name = self.name
-            return t
-        t = Tensor(arr, stop_gradient=self.stop_gradient)
-        t.name = self.name
+        return _make_tensor(arr, self.is_param, self.name, self.stop_gradient)
+
+
+def _make_tensor(arr, is_param, name, stop_gradient):
+    if is_param:
+        t = Parameter(arr, trainable=not stop_gradient)
+        t.name = name
         return t
+    t = Tensor(arr, stop_gradient=stop_gradient)
+    t.name = name
+    return t
 
 
 def _pack(obj: Any) -> Any:
     if isinstance(obj, Tensor):
-        arr = np.asarray(obj._data)
         from ..core import dtype as dtypes
 
-        return _TensorPayload(
-            arr, dtypes.dtype_name(obj.dtype), isinstance(obj, Parameter), obj.name, obj.stop_gradient
-        )
+        arr = np.asarray(obj._data)
+        dtype_name = dtypes.dtype_name(obj.dtype)
+        rec = {
+            _TENSOR_KEY: 1,
+            "dtype": dtype_name,
+            "is_param": isinstance(obj, Parameter),
+            "name": obj.name,
+            "stop_gradient": obj.stop_gradient,
+        }
+        if dtype_name == "bfloat16":
+            rec["shape"] = tuple(arr.shape)
+            rec["data"] = arr.tobytes()
+        else:
+            rec["data"] = arr
+        return rec
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -68,6 +75,15 @@ def _unpack(obj: Any, return_numpy=False) -> Any:
         t = obj.to_tensor()
         return t.numpy() if return_numpy else t
     if isinstance(obj, dict):
+        if obj.get(_TENSOR_KEY):
+            if obj["dtype"] == "bfloat16":
+                from ..core import dtype as dtypes
+
+                arr = np.frombuffer(obj["data"], dtype=dtypes.bfloat16).reshape(obj["shape"])
+            else:
+                arr = obj["data"]
+            t = _make_tensor(arr, obj["is_param"], obj["name"], obj["stop_gradient"])
+            return t.numpy() if return_numpy else t
         return {k: _unpack(v, return_numpy) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         out = [_unpack(v, return_numpy) for v in obj]

@@ -337,11 +337,36 @@ def save(layer, path, input_spec=None, **configs):
             for t, a in saved:
                 t._data = a
 
-    args = [
-        jax.ShapeDtypeStruct(tuple(abs(d) if d is not None and d != -1 else 1 for d in s.shape), s.dtype)
-        for s in specs
-    ]
-    exported = jax.export.export(jax.jit(pure))(*args)
+    # Dynamic dims (None/-1) export as symbolic shapes so the reloaded
+    # artifact accepts any size there (reference save_inference_model keeps
+    # dynamic batch). One shared scope across all inputs.
+    has_dynamic = any(d is None or d == -1 for s in specs for d in s.shape)
+    if has_dynamic:
+        scope = jax.export.SymbolicScope()
+        args = []
+        for si, s in enumerate(specs):
+            dims = ",".join(
+                f"d{si}_{di}" if (d is None or d == -1) else str(d)
+                for di, d in enumerate(s.shape)
+            )
+            shape = jax.export.symbolic_shape(dims, scope=scope) if dims else ()
+            args.append(jax.ShapeDtypeStruct(shape, s.dtype))
+    else:
+        args = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype) for s in specs]
+    try:
+        exported = jax.export.export(jax.jit(pure))(*args)
+    except Exception:
+        if not has_dynamic:
+            raise
+        # some ops aren't shape-polymorphic: fall back to a static export at
+        # size 1 for the dynamic dims (pre-existing behavior)
+        args = [
+            jax.ShapeDtypeStruct(
+                tuple(abs(d) if d is not None and d != -1 else 1 for d in s.shape), s.dtype
+            )
+            for s in specs
+        ]
+        exported = jax.export.export(jax.jit(pure))(*args)
     with open(path + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
     state = {k: np.asarray(v._data) for k, v in named_state}

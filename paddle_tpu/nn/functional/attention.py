@@ -98,13 +98,6 @@ def scaled_dot_product_attention(
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, name=None):
-    """Flash attention — Pallas TPU kernel when eligible, XLA exact otherwise."""
-    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
-    if _flash_eligible(q, k, causal, None, dropout, True):
-        try:
-            from ...ops.pallas.flash_attention import flash_attention_tpu
-
-            return flash_attention_tpu(q, k, v, causal=causal), None
-        except Exception:
-            pass
-    return scaled_dot_product_attention(q, k, v, is_causal=causal, dropout_p=dropout), None
+    """Flash attention — same routing as scaled_dot_product_attention (one
+    eligibility gate: Pallas kernel when it wins, XLA exact otherwise)."""
+    return scaled_dot_product_attention(query, key, value, is_causal=causal, dropout_p=dropout), None

@@ -110,9 +110,22 @@ def test_functional_flash_routing(monkeypatch):
     from paddle_tpu.nn.functional import attention as attention_mod
 
     monkeypatch.setattr(attention_mod, "_flash_eligible", lambda *a: True)
+    # assert the Pallas route actually ran — the silent except/fallback in
+    # scaled_dot_product_attention would otherwise make this test vacuous
+    from paddle_tpu.ops.pallas import flash_attention as fa_mod
+
+    calls = []
+    real = fa_mod.flash_attention_tpu
+
+    def recording(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fa_mod, "flash_attention_tpu", recording)
     rng = np.random.RandomState(5)
     x = [paddle.to_tensor(rng.randn(1, 512, 2, 32).astype(np.float32)) for _ in range(3)]
     out = F.scaled_dot_product_attention(*x, is_causal=True)
+    assert calls, "flash route did not run (silently fell back to exact path)"
     want = exact_attention(x[0]._data, x[1]._data, x[2]._data, True)
     np.testing.assert_allclose(np.asarray(out._data), np.asarray(want), atol=1e-4, rtol=1e-4)
 

@@ -1,0 +1,140 @@
+"""Regression tests for advisor/review findings.
+
+Each test pins a previously-broken behavior: nondiff-output ops under grad,
+GradScaler re-unscaling, dynamic-dim AOT export, non-leaf tensor hooks,
+self-describing checkpoints, nan/inf debug flag, p2p stubs.
+"""
+import os
+import pickle
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_topk_with_grad():
+    # dispatch replay path for ops with nondiff outputs used an undefined name
+    t = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+    t.stop_gradient = False
+    vals, idx = paddle.topk(t, k=3)
+    vals.sum().backward()
+    assert t.grad is not None
+    assert float(np.asarray(t.grad._data).sum()) == pytest.approx(12.0)
+
+
+def test_gradscaler_unscale_then_step_unscales_once():
+    lin = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=lin.parameters())
+    sc = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = lin(x).sum()
+    sc.scale(loss).backward()
+    p = next(p for p in lin.parameters() if p.grad is not None)
+    scaled = np.asarray(p.grad._data).copy()
+    sc.unscale_(opt)  # explicit unscale (e.g. for grad clipping)
+    once = np.asarray(p.grad._data).copy()
+    np.testing.assert_allclose(once, scaled / 8.0, rtol=1e-6)
+    sc.step(opt)  # must NOT divide again
+    np.testing.assert_allclose(np.asarray(p.grad._data), once, rtol=1e-6)
+    sc.update()
+    # next iteration unscales again
+    opt.clear_grad()
+    loss = lin(x).sum()
+    sc.scale(loss).backward()
+    sc.step(opt)
+    np.testing.assert_allclose(np.asarray(p.grad._data), once, rtol=1e-6)
+
+
+def test_gradscaler_static_scaling_unscales_every_step():
+    # update() must clear per-step unscale state even with dynamic scaling off
+    lin = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=lin.parameters())
+    sc = paddle.amp.GradScaler(init_loss_scaling=8.0, use_dynamic_loss_scaling=False)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    maxes = []
+    for _ in range(2):
+        opt.clear_grad()
+        sc.scale(lin(x).sum()).backward()
+        sc.step(opt)
+        sc.update()
+        p = next(p for p in lin.parameters() if p.grad is not None)
+        maxes.append(float(np.abs(np.asarray(p.grad._data)).max()))
+    assert maxes[0] == pytest.approx(maxes[1], rel=1e-5)
+
+
+def test_jit_save_dynamic_batch():
+    lin = paddle.nn.Linear(8, 3)
+    lin.eval()
+    d = tempfile.mkdtemp()
+    from paddle_tpu.static import InputSpec
+
+    paddle.jit.save(lin, os.path.join(d, "m"), input_spec=[InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(os.path.join(d, "m"))
+    for bs in (1, 5, 13):
+        x = paddle.to_tensor(np.random.randn(bs, 8).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(loaded(x)._data), np.asarray(lin(x)._data), atol=1e-5
+        )
+
+
+def test_nonleaf_register_hook_fires():
+    a = paddle.to_tensor(np.ones((2, 2), np.float32))
+    a.stop_gradient = False
+    b = a * 2.0
+    fired = []
+    b.register_hook(lambda g: fired.append(1) or (g * 3.0))
+    (b * 1.0).sum().backward()
+    assert fired
+    np.testing.assert_allclose(np.asarray(a.grad._data), 6.0)
+
+
+def test_checkpoint_readable_without_framework():
+    lin = paddle.nn.Linear(3, 3)
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "sd.pdparams")
+    paddle.save(lin.state_dict(), path)
+    raw = pickle.load(open(path, "rb"))  # plain pickle: no framework classes
+    for k, v in raw.items():
+        assert isinstance(v, dict) and v.get("__paddle_tpu_tensor__")
+        assert isinstance(v["data"], (np.ndarray, bytes))
+    # and the framework loads it back identically
+    sd2 = paddle.load(path)
+    for k in raw:
+        np.testing.assert_array_equal(
+            np.asarray(lin.state_dict()[k]._data), np.asarray(sd2[k]._data)
+        )
+
+
+def test_bf16_checkpoint_roundtrip():
+    lin = paddle.nn.Linear(3, 3)
+    lin.bfloat16()
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "sd16.pdparams")
+    paddle.save(lin.state_dict(), path)
+    sd2 = paddle.load(path)
+    for k, v in lin.state_dict().items():
+        assert str(sd2[k].dtype) == "bfloat16"
+        np.testing.assert_array_equal(
+            np.asarray(v._data, np.float32), np.asarray(sd2[k]._data, np.float32)
+        )
+
+
+def test_check_nan_inf_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        a = paddle.to_tensor(np.array([0.0], np.float32))
+        with pytest.raises(FloatingPointError, match="log"):
+            paddle.log(a - 1.0)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_p2p_stubs_raise():
+    from paddle_tpu.distributed import collective
+
+    t = paddle.to_tensor(np.ones((2,), np.float32))
+    for fn in (collective.send, collective.recv, collective.isend, collective.irecv):
+        with pytest.raises(NotImplementedError):
+            fn(t)
