@@ -91,6 +91,7 @@ from .framework.io import save, load  # noqa: F401
 from .framework.flags import set_flags, get_flags  # noqa: F401
 from . import distributed  # noqa: F401
 from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
 from . import profiler  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
 from . import distribution  # noqa: F401

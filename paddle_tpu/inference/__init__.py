@@ -1,0 +1,276 @@
+"""paddle.inference — the deployment path.
+
+Parity: reference ``paddle/fluid/inference/api/analysis_predictor.h:87``
+(AnalysisPredictor), ``paddle_inference_api.h`` (Config/Predictor/Tensor
+handles), ``paddle_pass_builder.cc`` (pass strategies).
+
+TPU-native design: the "analysis + IR pass pipeline" of the reference is the
+XLA compiler here — the saved artifact (``jit.save``: StableHLO bytes +
+params) is AOT-compiled by PJRT at load, so there is no pass zoo to
+configure. What remains is the deployment API surface: Config describing the
+artifact + device, a Predictor with named input/output handles (zero-copy
+into device buffers), ``clone()`` sharing the compiled executable between
+threads (the reference clones predictors per thread over one program,
+analysis_predictor.cc AnalysisPredictor::Clone), and batched Run.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Config:
+    """AnalysisConfig equivalent (reference paddle_analysis_config.h).
+
+    GPU/TRT/MKLDNN toggles are accepted for API compatibility and recorded;
+    on TPU the compiler covers what the reference's IR passes did, so they
+    do not change execution.
+    """
+
+    def __init__(self, model_dir_or_file: Optional[str] = None, params_file: Optional[str] = None):
+        if model_dir_or_file is not None and model_dir_or_file.endswith(".pdmodel"):
+            self._prefix = model_dir_or_file[: -len(".pdmodel")]
+        else:
+            self._prefix = model_dir_or_file
+        self._params_file = params_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._memory_optim = True
+        self._ir_optim = True
+        self._glog_info = False
+        self._cpu_math_threads = 1
+
+    # -- model location ---------------------------------------------------
+    def set_model(self, model_dir_or_file, params_file=None):
+        if model_dir_or_file.endswith(".pdmodel"):
+            model_dir_or_file = model_dir_or_file[: -len(".pdmodel")]
+        self._prefix = model_dir_or_file
+        self._params_file = params_file
+
+    def model_dir(self):
+        return self._prefix
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or ((self._prefix or "") + ".pdiparams")
+
+    # -- device -----------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # GPU request maps to the accelerator backend (TPU here)
+        self._device = "tpu"
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_tpu(self, device_id=0):
+        self._device = "tpu"
+        self._device_id = device_id
+
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = int(n)
+
+    # -- optimization toggles (XLA subsumes these; recorded for parity) ----
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = bool(flag)
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = bool(flag)
+
+    def switch_use_feed_fetch_ops(self, flag=False):
+        pass
+
+    def switch_specify_input_names(self, flag=True):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass  # TRT is CUDA-only; XLA AOT covers the role
+
+    def enable_mkldnn(self):
+        pass
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def summary(self):
+        return (
+            f"Config(model={self.prog_file()}, params={self.params_file()}, "
+            f"device={self._device}:{self._device_id})"
+        )
+
+
+class PredictorTensor:
+    """Named zero-copy I/O handle (reference paddle_tensor.h ZeroCopyTensor)."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool, index: int):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+        self._index = index
+
+    def reshape(self, shape):
+        # shapes are fixed (or symbolic) in the AOT artifact; accepted for
+        # API parity — actual shape comes from copy_from_cpu
+        self._shape_hint = tuple(shape)
+
+    def copy_from_cpu(self, data: np.ndarray):
+        if not self._is_input:
+            raise RuntimeError(f"'{self.name}' is an output handle")
+        self._owner._inputs[self._index] = np.ascontiguousarray(data)
+
+    def share_external_data(self, data):
+        # zero-copy: a device-resident (jax) array is used as-is — no
+        # host staging (reference ZeroCopyTensor::ShareExternalData)
+        if not self._is_input:
+            raise RuntimeError(f"'{self.name}' is an output handle")
+        if hasattr(data, "devices") or hasattr(data, "_data"):
+            self._owner._inputs[self._index] = getattr(data, "_data", data)
+        else:
+            self.copy_from_cpu(np.asarray(data))
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            raise RuntimeError(f"'{self.name}' is an input handle")
+        outs = self._owner._outputs
+        if outs is None:
+            raise RuntimeError("run() has not been called")
+        return np.asarray(outs[self._index])
+
+    def shape(self):
+        if self._is_input:
+            a = self._owner._inputs[self._index]
+            return list(a.shape) if a is not None else list(self._owner._input_specs[self._index][0])
+        outs = self._owner._outputs
+        return list(outs[self._index].shape) if outs is not None else []
+
+    def type(self):
+        if self._is_input:
+            return str(self._owner._input_specs[self._index][1])
+        outs = self._owner._outputs
+        return str(outs[self._index].dtype) if outs is not None else "float32"
+
+
+class Predictor:
+    """AnalysisPredictor equivalent: AOT module + named handles + clone.
+
+    The compiled executable (PJRT) is shared by reference across clones; each
+    clone has its own input/output slots, so per-thread use is race-free —
+    the same contract as AnalysisPredictor::Clone (analysis_predictor.cc).
+    """
+
+    def __init__(self, config: Config, _shared=None):
+        self._config = config
+        if _shared is not None:
+            (self._exported, self._call, self._input_specs, self._input_names,
+             self._output_names, self._n_outputs) = _shared
+        else:
+            self._load(config)
+        self._inputs: List[Optional[np.ndarray]] = [None] * len(self._input_names)
+        self._outputs = None
+        self._lock = threading.Lock()
+
+    def _load(self, config: Config):
+        import jax
+
+        from ..framework.io import load as fload
+
+        prefix = config._prefix
+        if prefix is None or not os.path.exists(prefix + ".pdmodel"):
+            raise ValueError(f"model file not found: {prefix}.pdmodel")
+        with open(prefix + ".pdmodel", "rb") as f:
+            exported = jax.export.deserialize(f.read())
+        meta = fload(config.params_file()) if os.path.exists(config.params_file()) else {}
+        specs = meta.get("specs") or []
+        self._exported = exported
+        self._input_specs = [(tuple(s[0]), s[1]) for s in specs] or [
+            (tuple(t.shape), str(t.dtype)) for t in exported.in_avals
+        ]
+        self._input_names = [
+            (s[2] if len(s) > 2 and s[2] else f"input_{i}") for i, s in enumerate(specs)
+        ] or [f"input_{i}" for i in range(len(self._input_specs))]
+        out_avals = exported.out_avals
+        self._n_outputs = len(out_avals) if isinstance(out_avals, (list, tuple)) else 1
+        self._output_names = [f"output_{i}" for i in range(self._n_outputs)]
+
+        # exported.call re-traces per invocation — wrap in jit so the PJRT
+        # executable is compiled once and cached (this is the predictor's
+        # whole job; without it every run() recompiles)
+        if config._device == "cpu":
+            cpu = jax.devices("cpu")[0]
+            self._call = jax.jit(exported.call, device=cpu)
+        else:
+            self._call = jax.jit(exported.call)
+
+    # -- handle API --------------------------------------------------------
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_input_handle(self, name):
+        return PredictorTensor(name, self, True, self._input_names.index(name))
+
+    def get_input_tensor(self, name):
+        return self.get_input_handle(name)
+
+    def get_output_handle(self, name):
+        return PredictorTensor(name, self, False, self._output_names.index(name))
+
+    def get_output_tensor(self, name):
+        return self.get_output_handle(name)
+
+    # -- execution ---------------------------------------------------------
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Run the AOT program. With ``inputs``, returns outputs directly
+        (list API, reference predictor.run(inputs)); otherwise uses the
+        copy_from_cpu'd handle slots."""
+        with self._lock:
+            if inputs is not None:
+                for i, a in enumerate(inputs):
+                    self._inputs[i] = np.ascontiguousarray(np.asarray(a))
+            missing = [n for n, a in zip(self._input_names, self._inputs) if a is None]
+            if missing:
+                raise RuntimeError(f"inputs not set: {missing}")
+            outs = self._call(*self._inputs)
+            if not isinstance(outs, (list, tuple)):
+                outs = (outs,)
+            # keep device-resident; copy_to_cpu does the D2H transfer
+            self._outputs = list(outs)
+            if inputs is not None:
+                return [np.asarray(o) for o in self._outputs]
+        return True
+
+    def clone(self):
+        shared = (self._exported, self._call, self._input_specs,
+                  self._input_names, self._output_names, self._n_outputs)
+        return Predictor(self._config, _shared=shared)
+
+    def clear_intermediate_tensor(self):
+        self._outputs = None
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+# Legacy aliases (reference paddle.inference exports)
+AnalysisConfig = Config
+create_paddle_predictor = create_predictor
+
+
+def get_version():
+    from .. import __version__
+
+    return __version__

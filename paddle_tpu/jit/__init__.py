@@ -353,8 +353,17 @@ def save(layer, path, input_spec=None, **configs):
             args.append(jax.ShapeDtypeStruct(shape, s.dtype))
     else:
         args = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype) for s in specs]
+    def _export(arg_list):
+        # multi-platform so a TPU-saved artifact deploys on CPU hosts too
+        # (Config.disable_gpu / CPU-only serving); ops without a multi-
+        # platform lowering (e.g. Pallas kernels) fall back to native-only
+        try:
+            return jax.export.export(jax.jit(pure), platforms=("cpu", "tpu"))(*arg_list)
+        except Exception:
+            return jax.export.export(jax.jit(pure))(*arg_list)
+
     try:
-        exported = jax.export.export(jax.jit(pure))(*args)
+        exported = _export(args)
     except Exception:
         if not has_dynamic:
             raise
@@ -366,7 +375,7 @@ def save(layer, path, input_spec=None, **configs):
             )
             for s in specs
         ]
-        exported = jax.export.export(jax.jit(pure))(*args)
+        exported = _export(args)
     with open(path + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
     state = {k: np.asarray(v._data) for k, v in named_state}

@@ -15,11 +15,27 @@ from ..core.tensor import Tensor
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, program=None, **kwargs):
-    """Maps to jit.save of the traced function (reference static/io.py)."""
-    raise NotImplementedError(
-        "static.save_inference_model: trace with paddle_tpu.jit.to_static and "
-        "use paddle_tpu.jit.save (static program capture IS jit capture here)"
-    )
+    """Serialize an inference program + params (reference static/io.py
+    ``save_inference_model``; artifact is loadable by ``load_inference_model``
+    and ``paddle_tpu.inference.Predictor``).
+
+    TPU-native adaptation: a "program" is a traced callable. ``feed_vars``
+    are InputSpecs (``static.data`` returns these) or example Tensors;
+    ``fetch_vars`` is the model — a Layer or callable mapping the feeds to
+    outputs. (The reference threads Variables of a global Program through
+    these arguments; with trace-capture the callable IS the program.)
+    """
+    fn = program if callable(program) else fetch_vars
+    if not callable(fn):
+        raise TypeError(
+            "save_inference_model: pass the model (Layer or callable) as "
+            "fetch_vars (or program=); static Programs are trace-captured here"
+        )
+    specs = [
+        s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+        for s in (feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars])
+    ]
+    _jit.save(fn, path_prefix, input_spec=specs)
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):

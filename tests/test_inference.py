@@ -1,0 +1,117 @@
+"""Inference deployment path — Config/Predictor over AOT artifacts.
+
+Reference surface: paddle.inference (analysis_predictor.h:87 AnalysisPredictor,
+paddle_inference_api.h Config/Predictor/ZeroCopyTensor). Tests cover
+save_inference_model → create_predictor → named-handle run, the list API,
+clone() thread-safety, dynamic batch, and error paths.
+"""
+import os
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import Config, Predictor, create_predictor
+from paddle_tpu.static import InputSpec
+
+
+@pytest.fixture(scope="module")
+def saved_model():
+    paddle.seed(7)
+    lin = paddle.nn.Sequential(
+        paddle.nn.Linear(16, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 4)
+    )
+    lin.eval()
+    d = tempfile.mkdtemp()
+    prefix = os.path.join(d, "model")
+    paddle.static.save_inference_model(
+        prefix, [InputSpec([None, 16], "float32", name="feat")], lin
+    )
+    return prefix, lin
+
+
+def test_config_surface(saved_model):
+    prefix, _ = saved_model
+    cfg = Config(prefix + ".pdmodel")
+    assert cfg.model_dir() == prefix
+    assert cfg.prog_file().endswith(".pdmodel")
+    cfg.enable_memory_optim()
+    cfg.switch_ir_optim(True)
+    cfg.disable_gpu()
+    assert not cfg.use_gpu()
+    assert "Config(" in cfg.summary()
+
+
+def test_predictor_handles(saved_model):
+    prefix, lin = saved_model
+    pred = create_predictor(Config(prefix))
+    assert pred.get_input_names() == ["feat"]
+    assert pred.get_output_names() == ["output_0"]
+    x = np.random.randn(3, 16).astype(np.float32)
+    h = pred.get_input_handle("feat")
+    h.copy_from_cpu(x)
+    assert pred.run() is True
+    out = pred.get_output_handle("output_0").copy_to_cpu()
+    want = np.asarray(lin(paddle.to_tensor(x))._data)
+    np.testing.assert_allclose(out, want, atol=1e-5)
+    assert pred.get_output_handle("output_0").shape() == [3, 4]
+
+
+def test_predictor_list_api_dynamic_batch(saved_model):
+    prefix, lin = saved_model
+    pred = create_predictor(Config(prefix))
+    for bs in (1, 6):
+        x = np.random.randn(bs, 16).astype(np.float32)
+        outs = pred.run([x])
+        want = np.asarray(lin(paddle.to_tensor(x))._data)
+        np.testing.assert_allclose(outs[0], want, atol=1e-5)
+
+
+def test_predictor_clone_threads(saved_model):
+    prefix, lin = saved_model
+    pred = create_predictor(Config(prefix))
+    clones = [pred.clone() for _ in range(4)]
+    assert all(c._call is pred._call for c in clones)  # shared executable
+    errs = []
+
+    def work(p):
+        x = np.random.randn(2, 16).astype(np.float32)
+        out = p.run([x])[0]
+        want = np.asarray(lin(paddle.to_tensor(x))._data)
+        errs.append(float(np.abs(out - want).max()))
+
+    threads = [threading.Thread(target=work, args=(c,)) for c in clones]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert len(errs) == 4 and max(errs) < 1e-5
+
+
+def test_error_paths(saved_model):
+    prefix, _ = saved_model
+    with pytest.raises(ValueError, match="not found"):
+        Predictor(Config(os.path.join(tempfile.mkdtemp(), "missing")))
+    pred = create_predictor(Config(prefix))
+    with pytest.raises(RuntimeError, match="inputs not set"):
+        pred.run()
+    with pytest.raises(RuntimeError, match="output handle"):
+        pred.get_output_handle("output_0").copy_from_cpu(np.zeros((1, 16), np.float32))
+
+
+def test_save_inference_model_requires_callable():
+    with pytest.raises(TypeError):
+        paddle.static.save_inference_model(
+            "/tmp/x", [InputSpec([1, 4], "float32")], fetch_vars=[1, 2]
+        )
+
+
+def test_load_inference_model(saved_model):
+    prefix, lin = saved_model
+    layer = paddle.static.load_inference_model(prefix)
+    x = np.random.randn(2, 16).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(layer(paddle.to_tensor(x))._data),
+        np.asarray(lin(paddle.to_tensor(x))._data),
+        atol=1e-5,
+    )
