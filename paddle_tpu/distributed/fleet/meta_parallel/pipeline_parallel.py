@@ -79,13 +79,234 @@ def spmd_pipeline_fn(stage_fn: Callable, n_stages: int, n_micro: int, axis: str 
     return pipelined
 
 
+class PipelineTrainStep:
+    """Compiled pipelined train step over non-uniform stages.
+
+    The whole GPipe timeline (n_micro + n_stages - 1 ticks) is ONE traced
+    ``lax.scan`` inside a ``shard_map`` over the 'pp' mesh axis; at each tick
+    every stage runs its OWN segment via ``lax.switch(stage_id, ...)`` —
+    embedding on stage 0, loss head on the last stage (the reference's
+    first/last-stage special cases, pipeline_parallel.py:152 `_forward_step` /
+    `pp_layers.py` loss_fn) — and hands its activation downstream with
+    ``lax.ppermute``. Reverse-mode AD through the scan reverses the permutes,
+    yielding the backward pipeline; ``jax.checkpoint`` around each stage call
+    bounds activation memory the way 1F1B's eager stashing discipline does.
+    Per-microbatch losses are mask-accumulated on the last stage and psum'd so
+    the mean loss is replicated (reference train_batch loss reduce
+    pipeline_parallel.py:220).
+    """
+
+    def __init__(self, pipeline_layer, optimizer, mesh, n_micro, axis="pp"):
+        self.pl = pipeline_layer
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.n_micro = int(n_micro)
+        self.axis = axis
+        self.n_stages = pipeline_layer.num_stages
+        pp_devices = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+        if self.n_stages != pp_devices:
+            raise ValueError(
+                f"PipelineLayer has {self.n_stages} stages but mesh axis "
+                f"'{axis}' has {pp_devices} devices; they must match"
+            )
+        self.params = [p for p in pipeline_layer.parameters() if not p.stop_gradient]
+        self.buffers = list(pipeline_layer.buffers())
+        self._jits = {}  # (mb_shape, dtype) -> (jitted step, carrier)
+        self._carrier = None  # (shape, dtype) of the inter-stage activation
+
+    # -- stage bodies ------------------------------------------------------
+    def _run_stage(self, stage_id, x):
+        """Run stage `stage_id`'s layers on Tensor `x` (tracer-safe)."""
+        for layer in self.pl.get_stage_layers(stage_id):
+            if isinstance(layer, Layer):
+                fwd = getattr(layer, "_pp_forward_func", None)
+                x = fwd(layer, x) if fwd is not None else layer(x)
+            else:
+                x = layer(x)
+        return x
+
+    def _probe_carrier(self, mb_input):
+        """Shape/dtype of the activation flowing between stages (= stage 0's
+        output). All interior boundaries must match it — the constraint of
+        collective-permute pipelining (uniform activation shape)."""
+        from ....core.engine import no_grad
+
+        def probe(arr):
+            with no_grad():
+                out = self._run_stage(0, Tensor(arr, stop_gradient=True))
+            return out._data
+
+        s = jax.eval_shape(probe, jax.ShapeDtypeStruct(mb_input.shape, mb_input.dtype))
+        for mid_s in range(1, self.n_stages - 1):
+            def probe_mid(arr, _s=mid_s):
+                with no_grad():
+                    out = self._run_stage(_s, Tensor(arr, stop_gradient=True))
+                return out._data
+            mid = jax.eval_shape(probe_mid, jax.ShapeDtypeStruct(s.shape, s.dtype))
+            if mid.shape != s.shape or mid.dtype != s.dtype:
+                raise ValueError(
+                    "pipeline stages must preserve activation shape/dtype "
+                    f"between boundaries: stage0 -> {s.shape}/{s.dtype}, "
+                    f"stage{mid_s} -> {mid.shape}/{mid.dtype}"
+                )
+        return s.shape, s.dtype
+
+    # -- compiled step -----------------------------------------------------
+    def _build(self):
+        from ....core import random as random_state
+        from ....core.engine import no_grad
+
+        n_stages, n_micro, axis = self.n_stages, self.n_micro, self.axis
+        params, buffers, pl = self.params, self.buffers, self.pl
+        loss_fn = getattr(pl, "_loss_fn", None)
+        carrier_shape, carrier_dtype = self._carrier
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def step_fn(param_arrays, opt_state, ids_mb, labels_mb, lr, key):
+            def loss_of(p_arrays):
+                def spmd(p_arrays, ids_mb, labels_mb):
+                    saved = [(t, t._data) for t in params + buffers]
+
+                    def bound(fn):
+                        # last positional arg is a per-(tick, stage) PRNG key
+                        # so dropout masks differ across microbatches/stages
+                        def wrapped(*args):
+                            *rest, k = args
+                            try:
+                                for t, a in zip(params, p_arrays):
+                                    t._data = a
+                                with random_state.traced_keys(k):
+                                    with no_grad():
+                                        return fn(*rest)
+                            finally:
+                                for t, a in saved:
+                                    t._data = a
+                        return wrapped
+
+                    @bound
+                    def first_stage(x, ids_t, lbl_t):
+                        h = self._run_stage(0, Tensor(ids_t, stop_gradient=True))
+                        return h._data.astype(carrier_dtype), jnp.float32(0.0)
+
+                    def mid_stage(s):
+                        @bound
+                        def run(x, ids_t, lbl_t):
+                            h = self._run_stage(s, Tensor(x))
+                            return h._data.astype(carrier_dtype), jnp.float32(0.0)
+                        return run
+
+                    @bound
+                    def last_stage(x, ids_t, lbl_t):
+                        out = self._run_stage(n_stages - 1, Tensor(x))
+                        if loss_fn is not None:
+                            l = loss_fn(out, Tensor(lbl_t, stop_gradient=True))
+                        else:
+                            l = out.mean()
+                        l = l._data if isinstance(l, Tensor) else l
+                        return x, l.astype(jnp.float32)
+
+                    branches = (
+                        [first_stage]
+                        + [mid_stage(s) for s in range(1, n_stages - 1)]
+                        + [last_stage]
+                    )
+                    stage_id = lax.axis_index(axis)
+
+                    def tick(carry, t):
+                        x, loss_acc = carry
+                        mb_idx = jnp.clip(t - stage_id, 0, n_micro - 1)
+                        ids_t = lax.dynamic_index_in_dim(ids_mb, mb_idx, keepdims=False)
+                        lbl_t = lax.dynamic_index_in_dim(labels_mb, mb_idx, keepdims=False)
+                        k_t = jax.random.fold_in(jax.random.fold_in(key, t), stage_id)
+                        run = jax.checkpoint(
+                            lambda x, i, l, k: lax.switch(stage_id, branches, x, i, l, k)
+                        )
+                        y, l = run(x, ids_t, lbl_t, k_t)
+                        valid = (t - stage_id >= 0) & (t - stage_id < n_micro)
+                        is_last = stage_id == n_stages - 1
+                        loss_acc = loss_acc + jnp.where(valid & is_last, l, 0.0)
+                        y = lax.ppermute(y, axis, perm)
+                        return (y, loss_acc), None
+
+                    x0 = jnp.zeros(carrier_shape, carrier_dtype)
+                    (_, loss_acc), _ = lax.scan(
+                        tick, (x0, jnp.float32(0.0)), jnp.arange(n_micro + n_stages - 1)
+                    )
+                    return lax.psum(loss_acc, axis) / n_micro
+
+                from jax.sharding import PartitionSpec as P
+                try:
+                    from jax import shard_map as _shard_map
+                    _check = {"check_vma": False}
+                except ImportError:  # older jax: experimental API, check_rep kwarg
+                    from jax.experimental.shard_map import shard_map as _shard_map
+                    _check = {"check_rep": False}
+
+                fn = _shard_map(
+                    spmd,
+                    mesh=self.mesh,
+                    in_specs=(
+                        tuple(P() for _ in p_arrays), P(), P(),
+                    ),
+                    out_specs=P(),
+                    **_check,
+                )
+                return fn(tuple(p_arrays), ids_mb, labels_mb)
+
+            loss, grads = jax.value_and_grad(loss_of)(list(param_arrays))
+            new_params, new_state = self.optimizer._functional_update(
+                param_arrays, grads, opt_state, lr, params=params
+            )
+            return loss, new_params, new_state
+
+        return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def __call__(self, inputs, labels):
+        from ....core import random as random_state
+        from ....core.engine import no_grad
+
+        ids = inputs._data if isinstance(inputs, Tensor) else jnp.asarray(inputs)
+        lbls = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+        b = ids.shape[0]
+        if b % self.n_micro:
+            raise ValueError(
+                f"batch size {b} not divisible by accumulate_steps {self.n_micro}"
+            )
+        mb = b // self.n_micro
+        ids_mb = ids.reshape((self.n_micro, mb) + ids.shape[1:])
+        lbls_mb = lbls.reshape((self.n_micro, mb) + lbls.shape[1:])
+
+        # one executable per input shape: the carrier (inter-stage activation
+        # shape) is baked into the schedule, so re-probe + rebuild on change
+        shape_key = (ids_mb.shape, str(ids_mb.dtype))
+        step = self._jits.get(shape_key)
+        if step is None:
+            self._carrier = self._probe_carrier(ids_mb[0])
+            step = self._jits[shape_key] = self._build()
+
+        with no_grad():
+            param_arrays = [p._data for p in self.params]
+            opt_state = self.optimizer._functional_state(self.params)
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+            key = random_state.next_key()
+            loss, new_params, new_state = step(
+                param_arrays, opt_state, ids_mb, lbls_mb, lr, key
+            )
+            for p, a in zip(self.params, new_params):
+                p._set_data(a)
+            self.optimizer._functional_restore(self.params, new_state)
+            self.optimizer._step_count += 1
+        return Tensor(loss)
+
+
 class PipelineParallelModel(Layer):
     """fleet.distributed_model output for pp_degree>1.
 
     ``train_batch(data, optimizer)`` compiles one SPMD program: microbatch
-    split → pipelined forward → loss on last stage → AD backward through the
-    ppermute schedule → optimizer update, all fused (reference train_batch
-    pipeline_parallel.py:152 + 1F1B :80).
+    split → pipelined forward (ppermute schedule over the 'pp' axis, per-stage
+    ``lax.switch`` bodies) → loss on last stage → AD backward through the
+    schedule → fused optimizer update (reference train_batch
+    pipeline_parallel.py:152 + 1F1B forward_backward_pipeline:80).
     """
 
     def __init__(self, layers, hcg, strategy):
@@ -102,21 +323,39 @@ class PipelineParallelModel(Layer):
         return self._layers(*args, **kwargs)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """Single-program pipelined train step (uniform-stage path)."""
+        """Pipelined train step. pp_degree>1 requires a PipelineLayer (the
+        reference imposes the same: pipeline_parallel.py asserts
+        isinstance(layers, PipelineLayer)); pp_degree==1 runs a plain fused
+        step."""
         from ....jit import CompiledTrainStep
+        from .pp_layers import PipelineLayer
 
         inputs, labels = data
-        loss_fn = getattr(self._layers, "_loss_fn", None)
 
-        def full_loss(model, x, y):
-            out = model(x)
-            if loss_fn is not None:
-                return loss_fn(out, y)
-            return out.mean()
+        if self.num_stages > 1 and not isinstance(self._layers, PipelineLayer):
+            raise TypeError(
+                "pp_degree>1 requires the model to be a PipelineLayer; got "
+                f"{type(self._layers).__name__}"
+            )
+        if self.num_stages > 1:
+            if self._train_fn is None:
+                self._train_fn = PipelineTrainStep(
+                    self._layers, optimizer, self._hcg.mesh,
+                    n_micro=max(self.micro_batches, 1), axis="pp",
+                )
+            loss = self._train_fn(inputs, labels)
+        else:
+            loss_fn = getattr(self._layers, "_loss_fn", None)
 
-        if self._train_fn is None:
-            self._train_fn = CompiledTrainStep(self._layers, full_loss, optimizer)
-        loss = self._train_fn(inputs, labels)
+            def full_loss(model, x, y):
+                out = model(x)
+                if loss_fn is not None:
+                    return loss_fn(out, y)
+                return out.mean()
+
+            if self._train_fn is None:
+                self._train_fn = CompiledTrainStep(self._layers, full_loss, optimizer)
+            loss = self._train_fn(inputs, labels)
         if lr_scheduler is not None:
             lr_scheduler.step()
         return loss

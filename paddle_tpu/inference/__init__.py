@@ -235,6 +235,11 @@ class Predictor:
         copy_from_cpu'd handle slots."""
         with self._lock:
             if inputs is not None:
+                if len(inputs) != len(self._input_names):
+                    raise ValueError(
+                        f"predictor expects {len(self._input_names)} inputs "
+                        f"{self._input_names}, got {len(inputs)}"
+                    )
                 for i, a in enumerate(inputs):
                     self._inputs[i] = np.ascontiguousarray(np.asarray(a))
             missing = [n for n, a in zip(self._input_names, self._inputs) if a is None]

@@ -360,6 +360,8 @@ def save(layer, path, input_spec=None, **configs):
         try:
             return jax.export.export(jax.jit(pure), platforms=("cpu", "tpu"))(*arg_list)
         except Exception:
+            # no multi-platform lowering (e.g. Pallas kernels): retry native-
+            # only; a second failure chains the original via __context__
             return jax.export.export(jax.jit(pure))(*arg_list)
 
     try:
