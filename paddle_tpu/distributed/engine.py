@@ -24,6 +24,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import random as random_state
@@ -72,6 +73,7 @@ class HybridParallelEngine:
         self.batch_specs = batch_specs
         self.dp_axes = dp_axes
         self.donate = donate
+        self.grad_accumulate = max(int(grad_accumulate), 1)
         self.params = [p for p in model.parameters() if not p.stop_gradient]
         self.buffers = list(model.buffers())
         self._jit = None
@@ -91,6 +93,22 @@ class HybridParallelEngine:
         spec = getattr(p, "opt_state_pspec", None) or getattr(p, "pspec", None)
         return _sharding(self.mesh, spec)
 
+    def _constrain_grads(self, grads):
+        """ZeRO-2/3: pin each grad to its ``grad_pspec`` layout so XLA emits a
+        reduce-scatter (grads land sharded over the 'sharding' axis) instead
+        of a replicated all-reduce — reference sharding_stage2.py:290
+        ``_get_reduce_fn`` reduce-to-owner, done by the partitioner."""
+        out = []
+        for p, g in zip(self.params, grads):
+            spec = getattr(p, "grad_pspec", None)
+            if g is None or spec is None:
+                out.append(g)
+            else:
+                out.append(
+                    jax.lax.with_sharding_constraint(g, _sharding(self.mesh, spec))
+                )
+        return out
+
     def _batch_sharding(self, i, arr):
         if self.batch_specs is not None and i < len(self.batch_specs):
             return _sharding(self.mesh, self.batch_specs[i])
@@ -104,7 +122,10 @@ class HybridParallelEngine:
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         params, buffers = self.params, self.buffers
 
-        def step_fn(param_arrays, opt_state, batch_arrays, lr, key):
+        def make_loss_of(batch_arrays, key):
+            """loss(p_arrays) with the model's params rebound to traced
+            arrays — shared by the plain and grad-accumulate paths."""
+
             def loss_of(p_arrays):
                 saved = [(t, t._data) for t in params + buffers]
                 try:
@@ -119,17 +140,54 @@ class HybridParallelEngine:
                     for t, a in saved:
                         t._data = a
 
+            return loss_of
+
+        def step_fn(param_arrays, opt_state, batch_arrays, lr, key):
+            loss_of = make_loss_of(batch_arrays, key)
             loss, grads = jax.value_and_grad(loss_of)(list(param_arrays))
+            grads = self._constrain_grads(grads)
+            new_params, new_state = opt._functional_update(
+                param_arrays, grads, opt_state, lr, params=params
+            )
+            return loss, new_params, new_state
+
+        def accum_step_fn(param_arrays, opt_state, batch_arrays, lr, key):
+            """Gradient accumulation: lax.scan over `grad_accumulate` chunks
+            of the batch (dim0 split), grads averaged into a ZeRO-sharded
+            accumulator, ONE optimizer update (reference GradientMergeOptimizer
+            / HybridParallelEngine grad-accumulate semantics)."""
+            acc = self.grad_accumulate
+            chunked = tuple(
+                a.reshape((acc, a.shape[0] // acc) + a.shape[1:]) for a in batch_arrays
+            )
+
+            def body(carry, chunk):
+                g_acc, loss_acc, k = carry
+                k, sub = jax.random.split(k)
+                loss_of = make_loss_of(chunk, sub)
+                loss, grads = jax.value_and_grad(loss_of)(list(param_arrays))
+                g_acc = [
+                    a if g is None else a + (g / acc).astype(a.dtype)
+                    for a, g in zip(g_acc, grads)
+                ]
+                g_acc = self._constrain_grads(g_acc)
+                loss_acc = loss_acc + (loss / acc).astype(jnp.float32)
+                return (g_acc, loss_acc, k), None
+
+            g0 = self._constrain_grads(
+                [jnp.zeros(a.shape, a.dtype) for a in param_arrays]
+            )
+            (grads, loss, _), _ = lax.scan(body, (g0, jnp.float32(0.0), key), chunked)
             new_params, new_state = opt._functional_update(
                 param_arrays, grads, opt_state, lr, params=params
             )
             return loss, new_params, new_state
 
         donate = (0, 1) if self.donate else ()
-        self._jit = jax.jit(step_fn, donate_argnums=donate)
+        fn = accum_step_fn if self.grad_accumulate > 1 else step_fn
+        self._jit = jax.jit(fn, donate_argnums=donate)
 
-    @no_grad()
-    def train_step(self, *batch):
+    def _prepare(self, *batch):
         self.place()
         if self._jit is None:
             self._build()
@@ -146,8 +204,27 @@ class HybridParallelEngine:
         ]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = random_state.next_key()
+        return param_arrays, opt_state, tuple(batch_arrays), lr, key
+
+    @no_grad()
+    def lower_text(self, *batch) -> str:
+        """StableHLO of the train step (introspection/tests: sharding
+        constraints appear as @Sharding custom calls / sdy ops). Side-effect
+        free: the global RNG stream is restored so introspection never
+        perturbs subsequent training."""
+        st = random_state._get()
+        saved_key = st.key
+        try:
+            args = self._prepare(*batch)
+            return self._jit.lower(*args).as_text()
+        finally:
+            st.key = saved_key
+
+    @no_grad()
+    def train_step(self, *batch):
+        param_arrays, opt_state, batch_arrays, lr, key = self._prepare(*batch)
         loss, new_params, new_state = self._jit(
-            param_arrays, opt_state, tuple(batch_arrays), lr, key
+            param_arrays, opt_state, batch_arrays, lr, key
         )
         for p, a in zip(self.params, new_params):
             p._set_data(a)

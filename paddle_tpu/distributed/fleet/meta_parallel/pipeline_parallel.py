@@ -235,12 +235,10 @@ class PipelineTrainStep:
                     return lax.psum(loss_acc, axis) / n_micro
 
                 from jax.sharding import PartitionSpec as P
-                try:
-                    from jax import shard_map as _shard_map
-                    _check = {"check_vma": False}
-                except ImportError:  # older jax: experimental API, check_rep kwarg
-                    from jax.experimental.shard_map import shard_map as _shard_map
-                    _check = {"check_rep": False}
+
+                from ...mesh import shard_map_compat
+
+                _shard_map, _check = shard_map_compat()
 
                 fn = _shard_map(
                     spmd,
@@ -338,10 +336,17 @@ class PipelineParallelModel(Layer):
                 f"{type(self._layers).__name__}"
             )
         if self.num_stages > 1:
+            acc = max(self.micro_batches, 1)
+            mb_cfg = self._strategy.pipeline_configs.get("micro_batch_size")
+            b = inputs.shape[0]
+            if acc > 1 and mb_cfg and b != acc * mb_cfg:
+                raise ValueError(
+                    f"batch size {b} != accumulate_steps({acc}) * "
+                    f"micro_batch_size({mb_cfg}); fix pipeline_configs"
+                )
             if self._train_fn is None:
                 self._train_fn = PipelineTrainStep(
-                    self._layers, optimizer, self._hcg.mesh,
-                    n_micro=max(self.micro_batches, 1), axis="pp",
+                    self._layers, optimizer, self._hcg.mesh, n_micro=acc, axis="pp",
                 )
             loss = self._train_fn(inputs, labels)
         else:

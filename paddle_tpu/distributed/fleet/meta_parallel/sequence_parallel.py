@@ -91,17 +91,13 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
     sp = lax.axis_size(axis_name)
 
     def seq_to_heads(x):
-        # (B, T/sp, H, D) -> (B, T, H/sp, D)
-        B, t, H, D = x.shape
-        x = x.reshape(B, t, sp, H // sp, D)
-        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=False)
-        return x.reshape(B, t * sp, H // sp, D)
+        # (B, T/sp, H, D) -> (B, T, H/sp, D); tiled all_to_all has a clean
+        # transpose rule, so AD through it yields the reverse exchange
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
     def heads_to_seq(x):
-        B, T, h, D = x.shape
-        x = x.reshape(B, sp, T // sp, h, D)
-        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3, tiled=False)
-        return x.reshape(B, T // sp, h * sp, D)
+        # (B, T, H/sp, D) -> (B, T/sp, H, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     scale = 1.0 / math.sqrt(qg.shape[-1])

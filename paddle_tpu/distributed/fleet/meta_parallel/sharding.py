@@ -84,6 +84,28 @@ class ShardingOptimizerStage1(Optimizer):
     def set_state_dict(self, sd):
         return self.inner.set_state_dict(sd)
 
+    # functional (fused-step) API must hit the INNER rule — inherited base
+    # methods would otherwise shadow __getattr__ delegation and raise
+    def _functional_state(self, params):
+        return self.inner._functional_state(params)
+
+    def _functional_update(self, *a, **k):
+        return self.inner._functional_update(*a, **k)
+
+    def _functional_restore(self, *a, **k):
+        return self.inner._functional_restore(*a, **k)
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    @property
+    def _step_count(self):
+        return self.inner._step_count
+
+    @_step_count.setter
+    def _step_count(self, v):
+        self.inner._step_count = v
+
 
 DygraphShardingOptimizer = ShardingOptimizerStage1
 

@@ -39,6 +39,19 @@ def global_mesh() -> Mesh:
     return _global_mesh
 
 
+def shard_map_compat():
+    """(shard_map, check_kwargs) across jax versions: the stable ``jax.shard_map``
+    takes ``check_vma``; the older experimental API takes ``check_rep``."""
+    try:
+        from jax import shard_map as sm
+
+        return sm, {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm, {"check_rep": False}
+
+
 def mesh_axis_size(axis: str) -> int:
     m = global_mesh()
     return m.shape.get(axis, 1) if hasattr(m.shape, "get") else dict(zip(m.axis_names, m.devices.shape)).get(axis, 1)

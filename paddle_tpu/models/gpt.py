@@ -50,6 +50,10 @@ class GPTConfig:
     initializer_range: float = 0.02
     use_mp_layers: bool = True  # Megatron-shardable weights (GSPMD specs)
     sequence_parallel: bool = False  # annotate activations with 'sp'
+    # "auto": ring attention whenever sequence_parallel and the mesh has an
+    # 'sp' axis >1 (the long-context path — O(T/sp) memory per device, K/V
+    # blocks rotate the ICI ring); "exact"/"flash" force those kernels.
+    attention_impl: str = "auto"
 
     @property
     def ffn_size(self):
@@ -75,7 +79,60 @@ class GPTAttention(nn.Layer):
         self.qkv = ColumnParallelLinear(h, 3 * h, has_bias=True, gather_output=False)
         self.proj = RowParallelLinear(h, h, has_bias=True, input_is_parallel=True)
         self.attn_dropout = config.attention_dropout
+        if config.attention_impl not in ("auto", "ring", "exact", "flash"):
+            raise ValueError(
+                f"attention_impl must be auto|ring|exact|flash, got {config.attention_impl!r}"
+            )
         self.config = config
+
+    def _ring_mesh(self):
+        """The global mesh iff ring attention should run: sequence_parallel
+        on, causal, an 'sp' axis of size >1 present, and no attention dropout
+        in play (ring, like flash, never materializes the score matrix a
+        dropout mask would apply to)."""
+        if not self.config.sequence_parallel or self.config.attention_impl not in ("auto", "ring"):
+            return None
+        if self.attn_dropout and self.training:
+            if self.config.attention_impl == "ring":
+                raise ValueError(
+                    "attention_impl='ring' does not support attention_dropout>0 "
+                    "while training; set attention_dropout=0.0"
+                )
+            return None  # auto: fall back to sdpa so dropout semantics hold
+        try:
+            from ..distributed.mesh import global_mesh
+
+            mesh = global_mesh()
+        except Exception:
+            return None
+        if mesh is None:
+            return None
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        return mesh if sizes.get("sp", 1) > 1 else None
+
+    def _ring_attention(self, q, k, v, mesh):
+        """shard_map island inside the GSPMD program: q/k/v (B,T,heads,D) get
+        sequence-sharded over 'sp' (batch over 'dp', heads over 'mp' when
+        present) and K/V blocks rotate via ppermute — the long-context path
+        the reference lacks. Attention dropout is skipped on this path (as in
+        flash kernels)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..distributed.mesh import shard_map_compat
+
+        _shard_map, _check = shard_map_compat()
+        from ..core.dispatch import eager_call
+        from ..distributed.fleet.meta_parallel.sequence_parallel import ring_attention
+
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        dp = "dp" if sizes.get("dp", 1) > 1 else None
+        hp = "mp" if sizes.get("mp", 1) > 1 else None
+        spec = P(dp, "sp", hp, None)
+        fn = _shard_map(
+            lambda a, b, c: ring_attention(a, b, c, "sp", causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **_check,
+        )
+        return eager_call("ring_attention_spmd", fn, [q, k, v])
 
     def forward(self, x, attn_mask=None):
         B, T = x.shape[0], x.shape[1]
@@ -84,10 +141,16 @@ class GPTAttention(nn.Layer):
         local_heads = local_h // self.head_dim
         qkv = qkv.reshape([B, T, 3, local_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
-            dropout_p=self.attn_dropout, training=self.training,
-        )
+        ring_mesh = self._ring_mesh() if attn_mask is None else None
+        if ring_mesh is not None:
+            out = self._ring_attention(q, k, v, ring_mesh)
+        else:
+            impl = self.config.attention_impl
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
+                dropout_p=self.attn_dropout, training=self.training,
+                impl=impl if impl in ("exact", "flash") else None,
+            )
         out = out.reshape([B, T, local_h])
         return self.proj(out)
 

@@ -38,16 +38,26 @@ def _flash_eligible(q, k, is_causal, attn_mask, dropout_p, training):
 
 
 def scaled_dot_product_attention(
-    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True,
+    name=None, impl=None
 ):
     """q,k,v: (B, T, H, D) — paddle convention. Returns (B, T, H, D).
 
     Causal/no-mask/no-dropout calls route to the Pallas flash kernel
     (blockwise online softmax, no T×T materialization); everything else uses
-    the XLA fused formulation.
+    the XLA fused formulation. ``impl``: None (auto) | "exact" (never flash)
+    | "flash" (force the Pallas kernel; raises if the call is ineligible).
     """
     q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
-    if _flash_eligible(q, k, is_causal, attn_mask, dropout_p, training):
+    if impl == "flash":
+        from ...ops.pallas.flash_attention import flash_attention_tpu
+
+        if not is_causal or attn_mask is not None or (dropout_p and training):
+            raise ValueError(
+                "impl='flash' requires is_causal=True, no attn_mask, no dropout"
+            )
+        return flash_attention_tpu(q, k, v, causal=True)
+    if impl is None and _flash_eligible(q, k, is_causal, attn_mask, dropout_p, training):
         try:
             from ...ops.pallas.flash_attention import flash_attention_tpu
 
