@@ -74,6 +74,33 @@ from .ops.manipulation import (  # noqa: F401
     imag, conj, moveaxis, swapaxes,
 )
 
+from .ops import generated as _generated  # noqa: F401
+from .ops import inplace as _inplace  # noqa: F401 (attaches Tensor methods)
+from .ops import control_flow as _control_flow  # noqa: F401
+from .ops.extra import (  # noqa: F401
+    einsum, segment_sum, segment_mean, segment_max, segment_min, histogramdd,
+)
+
+# generated ops join the top-level namespace without clobbering hand-written
+for _n, _fn in _generated.GENERATED.items():
+    if _n not in globals():
+        globals()[_n] = _fn
+del _n, _fn
+
+from .ops.misc import (  # noqa: F401
+    is_tensor, is_floating_point, is_integer, is_complex, is_empty, rank,
+    shape, tolist, reverse, multiplex, mode, poisson, set_printoptions,
+    create_parameter, disable_signal_handler, is_compiled_with_cinn,
+    is_compiled_with_rocm, is_compiled_with_xpu, is_compiled_with_npu,
+    is_compiled_with_mlu, is_compiled_with_ipu, get_cuda_rng_state,
+    set_cuda_rng_state,
+)
+from .linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, cov, eig, eigvals, eigvalsh, lstsq, lu,
+    multi_dot, qr, triangular_solve, norm, inverse,
+)
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import linalg  # noqa: F401
 from . import autograd  # noqa: F401
 from .autograd import grad  # noqa: F401
@@ -92,6 +119,7 @@ from .framework.flags import set_flags, get_flags  # noqa: F401
 from . import distributed  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
+from . import text  # noqa: F401
 from . import profiler  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
 from . import distribution  # noqa: F401

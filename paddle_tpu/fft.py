@@ -1,0 +1,92 @@
+"""paddle.fft — discrete Fourier transform op family.
+
+Parity: reference ``python/paddle/fft.py`` (fft/ifft/…/fftshift, backed by
+cuFFT kernels ``paddle/fluid/operators/spectral_op.cu``). TPU-native: jnp.fft
+lowers to XLA's FFT HLO which maps onto the TPU's dedicated FFT path; all ops
+route through ``eager_call`` so they participate in autograd and jit capture.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import as_tensor, eager_call
+
+
+def _mk(name, fn, differentiable=True):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        t = as_tensor(x)
+        return eager_call(
+            f"fft.{name}", fn, [t],
+            attrs={"n": n, "axis": axis, "norm": norm},
+            differentiable=differentiable,
+        )
+
+    op.__name__ = name
+    op.__doc__ = f"paddle.fft.{name} (reference python/paddle/fft.py)."
+    return op
+
+
+def _mk2(name, fn, differentiable=True):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        t = as_tensor(x)
+        return eager_call(
+            f"fft.{name}", fn, [t],
+            attrs={"s": s, "axes": tuple(axes), "norm": norm},
+            differentiable=differentiable,
+        )
+
+    op.__name__ = name
+    return op
+
+
+fft = _mk("fft", lambda a, n=None, axis=-1, norm="backward": jnp.fft.fft(a, n=n, axis=axis, norm=norm))
+ifft = _mk("ifft", lambda a, n=None, axis=-1, norm="backward": jnp.fft.ifft(a, n=n, axis=axis, norm=norm))
+rfft = _mk("rfft", lambda a, n=None, axis=-1, norm="backward": jnp.fft.rfft(a, n=n, axis=axis, norm=norm))
+irfft = _mk("irfft", lambda a, n=None, axis=-1, norm="backward": jnp.fft.irfft(a, n=n, axis=axis, norm=norm))
+hfft = _mk("hfft", lambda a, n=None, axis=-1, norm="backward": jnp.fft.hfft(a, n=n, axis=axis, norm=norm))
+ihfft = _mk("ihfft", lambda a, n=None, axis=-1, norm="backward": jnp.fft.ihfft(a, n=n, axis=axis, norm=norm))
+fft2 = _mk2("fft2", lambda a, s=None, axes=(-2, -1), norm="backward": jnp.fft.fft2(a, s=s, axes=axes, norm=norm))
+ifft2 = _mk2("ifft2", lambda a, s=None, axes=(-2, -1), norm="backward": jnp.fft.ifft2(a, s=s, axes=axes, norm=norm))
+rfft2 = _mk2("rfft2", lambda a, s=None, axes=(-2, -1), norm="backward": jnp.fft.rfft2(a, s=s, axes=axes, norm=norm))
+irfft2 = _mk2("irfft2", lambda a, s=None, axes=(-2, -1), norm="backward": jnp.fft.irfft2(a, s=s, axes=axes, norm=norm))
+fftn = _mk2("fftn", lambda a, s=None, axes=None, norm="backward": jnp.fft.fftn(a, s=s, axes=axes, norm=norm))
+ifftn = _mk2("ifftn", lambda a, s=None, axes=None, norm="backward": jnp.fft.ifftn(a, s=s, axes=axes, norm=norm))
+rfftn = _mk2("rfftn", lambda a, s=None, axes=None, norm="backward": jnp.fft.rfftn(a, s=s, axes=axes, norm=norm))
+irfftn = _mk2("irfftn", lambda a, s=None, axes=None, norm="backward": jnp.fft.irfftn(a, s=s, axes=axes, norm=norm))
+
+
+def fftshift(x, axes=None, name=None):
+    t = as_tensor(x)
+    return eager_call(
+        "fft.fftshift",
+        lambda a, axes=None: jnp.fft.fftshift(a, axes=axes),
+        [t], attrs={"axes": axes},
+    )
+
+
+def ifftshift(x, axes=None, name=None):
+    t = as_tensor(x)
+    return eager_call(
+        "fft.ifftshift",
+        lambda a, axes=None: jnp.fft.ifftshift(a, axes=axes),
+        [t], attrs={"axes": axes},
+    )
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.fftfreq(int(n), d=float(d)), stop_gradient=True)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.rfftfreq(int(n), d=float(d)), stop_gradient=True)
+
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+    "fftshift", "ifftshift", "fftfreq", "rfftfreq",
+]

@@ -33,3 +33,11 @@ from .loss import (  # noqa: F401
 )
 from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
 from .sparse_attention import sparse_attention  # noqa: F401
+from .vision import (  # noqa: F401
+    affine_grid, grid_sample, sequence_mask, temporal_shift, zeropad2d,
+    pairwise_distance, npair_loss, dice_loss, gather_tree,
+    max_unpool1d, max_unpool2d, max_unpool3d,
+)
+from .activation import relu_, elu_, softmax_  # noqa: F401
+from .loss import hsigmoid_loss, margin_cross_entropy  # noqa: F401
+from .common import class_center_sample  # noqa: F401

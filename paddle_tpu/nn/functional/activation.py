@@ -211,3 +211,33 @@ def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
         slope = jnp.broadcast_to(slope, x._data.shape)
     st = Tensor(slope)
     return eager_call("rrelu", lambda a, s: jnp.where(a >= 0, a, s * a), [x, st])
+
+
+def relu_(x, name=None):
+    """In-place relu (reference activation.py relu_)."""
+    from ...core.engine import grad_enabled
+
+    t = x
+    if not t.stop_gradient and grad_enabled():
+        raise RuntimeError("relu_(): in-place on a tensor that requires grad")
+    out = relu(t)
+    t._set_data(out._data)
+    return t
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...core.engine import grad_enabled
+
+    if not x.stop_gradient and grad_enabled():
+        raise RuntimeError("elu_(): in-place on a tensor that requires grad")
+    x._set_data(elu(x, alpha)._data)
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...core.engine import grad_enabled
+
+    if not x.stop_gradient and grad_enabled():
+        raise RuntimeError("softmax_(): in-place on a tensor that requires grad")
+    x._set_data(softmax(x, axis)._data)
+    return x

@@ -333,3 +333,33 @@ def bilinear(x1, x2, weight, bias=None, name=None):
         return fn(a, b, w, *rest)
 
     return eager_call("bilinear", fn2, args)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (reference common.py class_center_sample).
+    Host-side sampling like the reference's CPU path."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ...core.dispatch import as_tensor
+    from ...core.tensor import Tensor
+    from ...core import random as random_state
+
+    lt = as_tensor(label)
+    lab = np.asarray(lt._data).reshape(-1)
+    pos = np.unique(lab)
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    import jax
+
+    key = random_state.next_key()
+    n_extra = max(0, min(num_samples, num_classes) - pos.size)
+    if n_extra > 0 and rest.size:
+        perm = np.asarray(jax.random.permutation(key, rest.size))[:n_extra]
+        sampled = np.sort(np.concatenate([pos, rest[perm]]))
+    else:
+        sampled = pos
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    return (
+        Tensor(jnp.asarray(remap[lab]), stop_gradient=True),
+        Tensor(jnp.asarray(sampled), stop_gradient=True),
+    )

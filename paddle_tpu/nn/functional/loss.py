@@ -352,3 +352,69 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, red
         "sigmoid_focal_loss", fn, inputs,
         {"alpha": alpha, "gamma": gamma, "reduction": reduction, "has_norm": has_norm},
     )
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid over a default complete binary tree
+    (reference loss.py hsigmoid_loss / hierarchical_sigmoid_op)."""
+    import numpy as np
+    from ...core.dispatch import as_tensor, eager_call
+
+    x, y, w = as_tensor(input), as_tensor(label), as_tensor(weight)
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError("custom-tree hsigmoid: pass num_classes tree")
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+
+    def fn(xv, yv, wv, *rest, depth=1, num_classes=2):
+        bv = rest[0] if rest else None
+        # complete-tree paths: node index = (label + num_classes) >> (k+1),
+        # code bit = ((label + num_classes) >> k) & 1
+        lab = yv.reshape(-1).astype(jnp.int32) + num_classes
+        ks = jnp.arange(depth)
+        nodes = (lab[:, None] >> (ks + 1)[None, :]) - 1          # (B, depth)
+        codes = ((lab[:, None] >> ks[None, :]) & 1).astype(xv.dtype)
+        valid = nodes >= 0
+        nodes = jnp.clip(nodes, 0, wv.shape[0] - 1)
+        logits = jnp.einsum("bd,bkd->bk", xv, wv[nodes])
+        if bv is not None:
+            logits = logits + bv.reshape(-1)[nodes]
+        # bce with code as target; per-sample (N, 1) like the reference
+        losses = jnp.maximum(logits, 0) - logits * codes + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return (losses * valid).sum(-1, keepdims=True)
+
+    args = [x, y, w] + ([as_tensor(bias)] if bias is not None else [])
+    return eager_call(
+        "hsigmoid_loss", fn, args,
+        attrs={"depth": depth, "num_classes": int(num_classes)},
+    )
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False, reduction="mean"):
+    """ArcFace/CosFace margin softmax (reference loss.py margin_cross_entropy;
+    the mp-sharded variant rides GSPMD when logits carry an 'mp' sharding)."""
+    from ...core.dispatch import as_tensor, eager_call
+
+    lt, yt = as_tensor(logits), as_tensor(label)
+
+    def fn(lg, yv, m1=1.0, m2=0.5, m3=0.0, s=64.0, reduction="mean"):
+        yv = yv.reshape(-1)
+        onehot = jax.nn.one_hot(yv, lg.shape[-1], dtype=lg.dtype)
+        theta = jnp.arccos(jnp.clip(lg, -1 + 1e-7, 1 - 1e-7))
+        target = jnp.cos(m1 * theta + m2) - m3
+        adj = jnp.where(onehot > 0, target, lg) * s
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -(onehot * logp).sum(-1)
+        if reduction == "mean":
+            loss = loss.mean()
+        elif reduction == "sum":
+            loss = loss.sum()
+        return (loss, jax.nn.softmax(adj, -1))
+
+    loss, sm = eager_call(
+        "margin_cross_entropy", fn, [lt, yt],
+        attrs={"m1": float(margin1), "m2": float(margin2), "m3": float(margin3),
+               "s": float(scale), "reduction": reduction},
+    )
+    return (loss, sm) if return_softmax else loss

@@ -1,0 +1,281 @@
+"""Vision & misc functional ops.
+
+Parity: reference ``python/paddle/nn/functional/vision.py`` (grid_sample,
+affine_grid, pixel ops), ``input.py`` (one_hot/embedding), sequence ops
+(``sequence_mask`` — paddle/fluid/layers/sequence_lod.py), temporal_shift
+(``operators/temporal_shift_op.cu``), distance ops. All jnp builders through
+eager_call (autograd/jit/AMP for free).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import as_tensor, eager_call
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D affine grid (N,2,3) -> (N,H,W,2). Reference vision.py affine_grid."""
+    t = as_tensor(theta)
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    N, C, H, W = [int(v) for v in out_shape]
+
+    def fn(th, H=0, W=0, align=True):
+        if align:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+        gx, gy = jnp.meshgrid(xs, ys)  # (H, W)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # (H, W, 3)
+        return jnp.einsum("hwk,nck->nhwc", base.astype(th.dtype), th)
+
+    return eager_call(
+        "affine_grid", fn, [t], attrs={"H": H, "W": W, "align": bool(align_corners)}
+    )
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    """Bilinear/nearest sampling of x (N,C,H,W) at grid (N,Hg,Wg,2) in [-1,1].
+    Reference vision.py grid_sample / grid_sampler_op.cu."""
+    xt, gt = as_tensor(x), as_tensor(grid)
+
+    def fn(feat, g, mode="bilinear", padding_mode="zeros", align=True):
+        N, C, H, W = feat.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+
+        def gather(feat_n, yy, xx):
+            # feat_n: (C,H,W); yy/xx int arrays (Hg,Wg)
+            inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yc = jnp.clip(yy, 0, H - 1)
+            xc = jnp.clip(xx, 0, W - 1)
+            v = feat_n[:, yc, xc]  # (C,Hg,Wg)
+            if padding_mode == "zeros":
+                v = jnp.where(inb[None], v, 0.0)
+            return v
+
+        def sample_n(feat_n, fx_n, fy_n):
+            if mode == "nearest":
+                return gather(feat_n, jnp.round(fy_n).astype(jnp.int32), jnp.round(fx_n).astype(jnp.int32))
+            x0 = jnp.floor(fx_n)
+            y0 = jnp.floor(fy_n)
+            wx = fx_n - x0
+            wy = fy_n - y0
+            x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+            v00 = gather(feat_n, y0i, x0i)
+            v01 = gather(feat_n, y0i, x0i + 1)
+            v10 = gather(feat_n, y0i + 1, x0i)
+            v11 = gather(feat_n, y0i + 1, x0i + 1)
+            return (
+                v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+                + v10 * (1 - wx) * wy + v11 * wx * wy
+            )
+
+        return jax.vmap(sample_n)(feat, fx, fy)
+
+    return eager_call(
+        "grid_sample", fn, [xt, gt],
+        attrs={"mode": mode, "padding_mode": padding_mode, "align": bool(align_corners)},
+    )
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    """mask[i, j] = j < lengths[i]. Reference sequence_lod.py sequence_mask."""
+    lt = as_tensor(lengths)
+    import numpy as np
+
+    if maxlen is None:
+        maxlen = int(np.asarray(lt._data).max())
+
+    def fn(l, maxlen=0, dtype="int64"):
+        return (jnp.arange(maxlen) < l[..., None]).astype(dtype)
+
+    return eager_call(
+        "sequence_mask", fn, [lt], attrs={"maxlen": int(maxlen), "dtype": dtype},
+        differentiable=False,
+    )
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None, data_format="NCHW"):
+    """TSM shift (reference temporal_shift_op.cu)."""
+    t = as_tensor(x)
+
+    def fn(a, seg_num=1, shift_ratio=0.25):
+        NT, C, H, W = a.shape
+        N = NT // seg_num
+        v = a.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        back = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
+        keep = v[:, :, c2:]
+        return jnp.concatenate([back, fwd, keep], axis=2).reshape(NT, C, H, W)
+
+    return eager_call(
+        "temporal_shift", fn, [t],
+        attrs={"seg_num": int(seg_num), "shift_ratio": float(shift_ratio)},
+    )
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    t = as_tensor(x)
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    l, r, top, bot = [int(p) for p in padding]
+
+    def fn(a, l=0, r=0, top=0, bot=0):
+        return jnp.pad(a, ((0, 0), (0, 0), (top, bot), (l, r)))
+
+    return eager_call("zeropad2d", fn, [t], attrs={"l": l, "r": r, "top": top, "bot": bot})
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    xt, yt = as_tensor(x), as_tensor(y)
+
+    def fn(a, b, p=2.0, eps=1e-6, keepdim=False):
+        d = a - b + eps
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return eager_call(
+        "pairwise_distance", fn, [xt, yt],
+        attrs={"p": float(p), "eps": float(epsilon), "keepdim": bool(keepdim)},
+    )
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Reference loss.py npair_loss."""
+    a, pos, lab = as_tensor(anchor), as_tensor(positive), as_tensor(labels)
+
+    def fn(an, po, lb, l2_reg=0.002):
+        B = an.shape[0]
+        lb = lb.reshape(-1)
+        same = (lb[:, None] == lb[None, :]).astype(an.dtype)
+        tgt = same / jnp.maximum(same.sum(-1, keepdims=True), 1.0)
+        logits = an @ po.T
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        xent = -(tgt * logp).sum(-1).mean()
+        reg = (jnp.sum(an * an) + jnp.sum(po * po)) / B * l2_reg * 0.25
+        return xent + reg
+
+    return eager_call("npair_loss", fn, [a, pos, lab], attrs={"l2_reg": float(l2_reg)})
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Reference loss.py dice_loss."""
+    x, y = as_tensor(input), as_tensor(label)
+
+    def fn(p, t, eps=1e-5):
+        t1 = jax.nn.one_hot(t.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * t1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(t1, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + eps) / (union + eps))
+
+    return eager_call("dice_loss", fn, [x, y], attrs={"eps": float(epsilon)})
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree_op)."""
+    it, pt = as_tensor(ids), as_tensor(parents)
+
+    def fn(idv, par):
+        T, B, W = idv.shape
+
+        def step(carry, t):
+            beams = carry  # (B, W) beam index being traced
+            out = jnp.take_along_axis(idv[t], beams, axis=1)
+            nxt = jnp.take_along_axis(par[t], beams, axis=1)
+            return nxt, out
+
+        init = jnp.tile(jnp.arange(W)[None], (B, 1))
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+
+    return eager_call("gather_tree", fn, [it, pt], differentiable=False)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
+    """Reference unpool_op: scatter pooled values back to indices."""
+    xt, it = as_tensor(x), as_tensor(indices)
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+
+    def fn(v, idx, kh=2, kw=2, sh=2, sw=2, oh=0, ow=0):
+        N, C, H, W = v.shape
+        OH = oh or H * sh
+        OW = ow or W * sw
+        flat = jnp.zeros((N, C, OH * OW), v.dtype)
+        out = flat.at[
+            jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None],
+            idx.reshape(N, C, -1),
+        ].set(v.reshape(N, C, -1))
+        return out.reshape(N, C, OH, OW)
+
+    oh, ow = (0, 0)
+    if output_size is not None:
+        oh, ow = int(output_size[-2]), int(output_size[-1])
+    return eager_call(
+        "max_unpool2d", fn, [xt, it],
+        attrs={"kh": kernel_size[0], "kw": kernel_size[1],
+               "sh": stride[0], "sw": stride[1], "oh": oh, "ow": ow},
+    )
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL", output_size=None, name=None):
+    xt = as_tensor(x)
+    x4 = xt.unsqueeze(-2)
+    i4 = as_tensor(indices).unsqueeze(-2)
+    ks = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    st = stride if stride is not None else ks
+    st = st if isinstance(st, int) else st[0]
+    osz = None if output_size is None else [1, int(output_size[-1])]
+    out = max_unpool2d(x4, i4, (1, ks), (1, st), output_size=osz)
+    return out.squeeze(-2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW", output_size=None, name=None):
+    xt, it = as_tensor(x), as_tensor(indices)
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * 3
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+
+    def fn(v, idx, kd=2, kh=2, kw=2, sd=2, sh=2, sw=2, od=0, oh=0, ow=0):
+        N, C, D, H, W = v.shape
+        OD, OH, OW = od or D * sd, oh or H * sh, ow or W * sw
+        flat = jnp.zeros((N, C, OD * OH * OW), v.dtype)
+        out = flat.at[
+            jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None],
+            idx.reshape(N, C, -1),
+        ].set(v.reshape(N, C, -1))
+        return out.reshape(N, C, OD, OH, OW)
+
+    od = oh = ow = 0
+    if output_size is not None:
+        od, oh, ow = [int(v) for v in output_size[-3:]]
+    return eager_call(
+        "max_unpool3d", fn, [xt, it],
+        attrs={"kd": kernel_size[0], "kh": kernel_size[1], "kw": kernel_size[2],
+               "sd": stride[0], "sh": stride[1], "sw": stride[2],
+               "od": od, "oh": oh, "ow": ow},
+    )
+
+
+__all__ = [
+    "affine_grid", "grid_sample", "sequence_mask", "temporal_shift",
+    "zeropad2d", "pairwise_distance", "npair_loss", "dice_loss",
+    "gather_tree", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+]

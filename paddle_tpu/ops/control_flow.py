@@ -1,0 +1,142 @@
+"""Control-flow ops.
+
+Parity: reference ``paddle/fluid/operators/controlflow/`` —
+``conditional_block_op.cc`` (paddle.static.nn.cond), ``while_op.cc``
+(while_loop), plus ``case``/``switch_case``
+(``python/paddle/fluid/layers/control_flow.py``). TPU-native semantics:
+
+* eager (concrete predicate): plain Python branch/loop — what the reference's
+  dygraph does;
+* traced (jit / to_static / inside an engine): lowered to ``lax.cond`` /
+  ``lax.switch`` / ``lax.while_loop`` so the compiled program carries real
+  XLA control flow instead of unrolled or host-synced branches.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import as_tensor
+from ..core.tensor import Tensor
+
+
+def _is_traced(x) -> bool:
+    return isinstance(getattr(x, "_data", x), jax.core.Tracer)
+
+
+def _to_arrays(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t._data if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor),
+    )
+
+
+def _to_tensors(tree):
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a) if hasattr(a, "dtype") else a, tree
+    )
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None):
+    """paddle.static.nn.cond — reference conditional_block_op.cc.
+
+    ``true_fn``/``false_fn`` take no arguments (closures over tensors) and
+    must return the same structure.
+    """
+    p = as_tensor(pred)
+    if not _is_traced(p):
+        return true_fn() if bool(p._data) else false_fn()
+    pa = p._data.reshape(())
+
+    def wrap(fn):
+        def run(_):
+            return _to_arrays(fn())
+        return run
+
+    out = lax.cond(pa.astype(bool), wrap(true_fn), wrap(false_fn), 0)
+    return _to_tensors(out)
+
+
+def case(pred_fn_pairs: Sequence, default: Callable = None, name=None):
+    """First pair whose predicate is true wins (reference layers.case)."""
+    if not pred_fn_pairs:
+        raise ValueError("case() needs at least one (pred, fn) pair")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return cond(pred, fn, fn)
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None, name=None):
+    """Index-selected branch (reference layers.switch_case → lax.switch)."""
+    idx = as_tensor(branch_index)
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        # map arbitrary keys onto dense switch indices
+        def to_dense(i):
+            d = jnp.zeros((), jnp.int32) + len(fns)  # default slot
+            for j, k in enumerate(keys):
+                d = jnp.where(i == k, j, d)
+            return d
+        dense = to_dense(idx._data.astype(jnp.int32))
+    else:
+        fns = list(branch_fns)
+        i = idx._data.astype(jnp.int32)
+        # out-of-range (either side) selects the default slot, per reference
+        dense = jnp.where((i < 0) | (i >= len(fns)), len(fns), i)
+    if default is not None:
+        fns = fns + [default]
+    else:
+        fns = fns + [fns[-1]]
+    if not _is_traced(idx):
+        return fns[min(int(dense), len(fns) - 1)]()
+
+    def wrap(fn):
+        def run(_):
+            return _to_arrays(fn())
+        return run
+
+    out = lax.switch(jnp.minimum(dense, len(fns) - 1), [wrap(f) for f in fns], 0)
+    return _to_tensors(out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop — reference while_op.cc.
+
+    ``cond_fn(*vars) -> bool tensor``, ``body_fn(*vars) -> vars'``. Eagerly a
+    Python loop; traced, a ``lax.while_loop`` (shape-stable carry required,
+    as the reference's while_op requires stable var shapes across steps).
+    """
+    vars_t = [as_tensor(v) if not isinstance(v, (list, tuple)) else v for v in loop_vars]
+    traced = any(_is_traced(v) for v in vars_t if isinstance(v, Tensor))
+    if not traced:
+        state = list(vars_t)
+        while bool(as_tensor(cond_fn(*state))._data):
+            out = body_fn(*state)
+            state = list(out) if isinstance(out, (list, tuple)) else [out]
+        return state
+
+    def carry_cond(arrays):
+        ts = [Tensor(a) for a in arrays]
+        c = cond_fn(*ts)
+        return as_tensor(c)._data.reshape(()).astype(bool)
+
+    def carry_body(arrays):
+        ts = [Tensor(a) for a in arrays]
+        out = body_fn(*ts)
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return tuple(as_tensor(o)._data for o in out)
+
+    init = tuple(as_tensor(v)._data for v in vars_t)
+    final = lax.while_loop(carry_cond, carry_body, init)
+    return [Tensor(a) for a in final]
+
+
+__all__ = ["cond", "case", "switch_case", "while_loop"]

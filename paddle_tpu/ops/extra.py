@@ -1,0 +1,85 @@
+"""Hand-written op additions that need more than a yaml one-liner.
+
+einsum (reference ``python/paddle/tensor/einsum.py``), segment reductions
+(reference ``paddle/fluid/operators/segment_ops/`` — paddle.incubate.segment_*
+and paddle.geometric.segment_*), histogramdd.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import as_tensor, eager_call
+
+
+def einsum(equation, *operands, **kwargs):
+    """paddle.einsum — XLA contracts straight onto the MXU.
+    Reference: python/paddle/tensor/einsum.py (1,000+ LoC planner); jnp's
+    opt_einsum planner subsumes it."""
+    if operands and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    tensors = [as_tensor(t) for t in operands]
+    return eager_call(
+        "einsum",
+        lambda *arrays, equation=None: jnp.einsum(equation, *arrays),
+        tensors, attrs={"equation": equation},
+    )
+
+
+def _segment(name, reducer):
+    def op(data, segment_ids, name=None):
+        t = as_tensor(data)
+        seg = as_tensor(segment_ids)
+        # num_segments must be static for XLA: read it from concrete ids
+        # (matches the reference kernel, which sizes the output on host)
+        ids = np.asarray(seg._data)
+        num = int(ids.max()) + 1 if ids.size else 0
+        return eager_call(
+            f"segment_{name}",
+            lambda d, s, num=0: reducer(d, s, num),
+            [t, seg], attrs={"num": num}, nondiff_outputs=(),
+        )
+
+    op.__name__ = f"segment_{name}"
+    op.__doc__ = (
+        f"paddle.incubate.segment_{name} "
+        "(reference paddle/fluid/operators/segment_ops)."
+    )
+    return op
+
+
+def _seg_mean(d, s, num):
+    tot = jax.ops.segment_sum(d, s, num_segments=num)
+    cnt = jax.ops.segment_sum(jnp.ones_like(d), s, num_segments=num)
+    return tot / jnp.maximum(cnt, 1)
+
+
+segment_sum = _segment("sum", lambda d, s, num: jax.ops.segment_sum(d, s, num_segments=num))
+segment_mean = _segment("mean", _seg_mean)
+segment_max = _segment("max", lambda d, s, num: jax.ops.segment_max(d, s, num_segments=num))
+segment_min = _segment("min", lambda d, s, num: jax.ops.segment_min(d, s, num_segments=num))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    t = as_tensor(x)
+    args = [t]
+    if weights is not None:
+        args.append(as_tensor(weights))
+
+    def fn(a, *w, bins=10, ranges=None, density=False):
+        h, edges = jnp.histogramdd(
+            a, bins=bins, range=ranges, density=density,
+            weights=w[0] if w else None,
+        )
+        return (h,) + tuple(edges)
+
+    outs = eager_call(
+        "histogramdd", fn, args,
+        attrs={"bins": bins, "ranges": ranges, "density": density},
+        differentiable=False,
+    )
+    return outs[0], list(outs[1:])
+
+
+__all__ = ["einsum", "segment_sum", "segment_mean", "segment_max", "segment_min", "histogramdd"]

@@ -1,0 +1,86 @@
+"""In-place op variants (``x.add_(y)``, ``x.clip_(...)``, …).
+
+Parity: the reference registers ``<op>_``/``Inplace`` kernel variants and
+checks tensor inplace-version counters for autograd safety
+(``paddle/fluid/imperative/dygraph_grad_maker.h`` inplace version checking).
+TPU-native: arrays are immutable under XLA, so "in-place" rebinds the
+tensor's buffer to the op result — observationally identical (paddle's
+inplace ops also return the tensor). When the target is autograd-tracked and
+grad is enabled we refuse, mirroring the reference's leaf-inplace error, to
+keep the vjp tape sound.
+"""
+from __future__ import annotations
+
+from ..core.engine import grad_enabled
+from ..core.tensor import Tensor
+
+# base-op name -> resolved lazily from the assembled paddle namespace
+_INPLACE_BASES = [
+    "add", "subtract", "multiply", "divide", "remainder", "pow",
+    "clip", "scale", "exp", "sqrt", "rsqrt", "reciprocal", "round",
+    "floor", "ceil", "trunc", "abs", "tanh", "sigmoid", "erfinv", "sin",
+    "cos", "neg", "sign", "lerp", "cast", "flatten", "reshape", "squeeze",
+    "unsqueeze", "clone", "tril", "triu", "digamma", "lgamma",
+    "nan_to_num", "logit", "masked_fill", "index_add", "put_along_axis",
+    "scatter", "renorm", "fill_diagonal",
+]
+
+
+def _make_inplace(base_name):
+    def op_(self, *args, **kwargs):
+        import paddle_tpu as _p
+
+        base = getattr(_p, base_name, None)
+        if base is None:
+            from . import generated
+
+            base = generated.GENERATED.get(base_name)
+        if base is None:
+            raise NotImplementedError(f"no base op {base_name} for {base_name}_")
+        if not self.stop_gradient and grad_enabled():
+            raise RuntimeError(
+                f"{base_name}_(): in-place on a tensor that requires grad is "
+                "not supported (reference: inplace version-check error); use "
+                f"the out-of-place {base_name}() instead"
+            )
+        out = base(self, *args, **kwargs)
+        self._set_data(out._data if isinstance(out, Tensor) else out)
+        return self
+
+    op_.__name__ = base_name + "_"
+    op_.__doc__ = f"In-place variant of `{base_name}` (rebinds this tensor's buffer)."
+    return op_
+
+
+def fill_(self, value):
+    import jax.numpy as jnp
+
+    if not self.stop_gradient and grad_enabled():
+        raise RuntimeError("fill_(): in-place on a tensor that requires grad")
+    self._set_data(jnp.full_like(self._data, value))
+    return self
+
+
+def zero_(self):
+    return fill_(self, 0.0)
+
+
+INPLACE_OPS = {}
+
+
+def attach():
+    for base in _INPLACE_BASES:
+        name = base + "_"
+        fn = _make_inplace(base)
+        INPLACE_OPS[name] = fn
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+    INPLACE_OPS["fill_"] = fill_
+    INPLACE_OPS["zero_"] = zero_
+    if not hasattr(Tensor, "fill_"):
+        Tensor.fill_ = fill_
+    if not hasattr(Tensor, "zero_"):
+        Tensor.zero_ = zero_
+
+
+attach()

@@ -1,0 +1,194 @@
+"""Top-level misc ops closing the reference namespace gap.
+
+Parity targets: python/paddle/tensor/attribute.py (rank/shape/is_*),
+math.py (multiplex), manipulation.py (reverse), random.py (poisson),
+search.py (mode), framework (set_printoptions, create_parameter).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import as_tensor, eager_call
+from ..core.tensor import Tensor
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(as_tensor(x)._data.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(as_tensor(x)._data.dtype, jnp.integer)
+
+
+def is_complex(x):
+    return jnp.issubdtype(as_tensor(x)._data.dtype, jnp.complexfloating)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(as_tensor(x)._data.size == 0), stop_gradient=True)
+
+
+def rank(input):
+    return Tensor(jnp.asarray(as_tensor(input)._data.ndim), stop_gradient=True)
+
+
+def shape(input):
+    return Tensor(jnp.asarray(as_tensor(input)._data.shape, jnp.int64), stop_gradient=True)
+
+
+def tolist(x):
+    return np.asarray(as_tensor(x)._data).tolist()
+
+
+def reverse(x, axis, name=None):
+    """reference manipulation: reverse == flip."""
+    from . import manipulation
+
+    return manipulation.flip(x, axis)
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (reference multiplex_op)."""
+    tensors = [as_tensor(t) for t in inputs] + [as_tensor(index)]
+
+    def fn(*arrays):
+        *cands, idx = arrays
+        stacked = jnp.stack(cands)  # (K, B, ...)
+        idx = idx.reshape(-1).astype(jnp.int32)
+        return stacked[idx, jnp.arange(stacked.shape[1])]
+
+    return eager_call("multiplex", fn, tensors)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value (reference mode_op): returns (values, indices)."""
+    t = as_tensor(x)
+
+    def fn(a, axis=-1, keepdim=False):
+        s = jnp.sort(a, axis=axis)
+        si = jnp.argsort(a, axis=axis)
+        n = a.shape[axis]
+        s_m = jnp.moveaxis(s, axis, -1)
+        si_m = jnp.moveaxis(si, axis, -1)
+        runs = jnp.cumsum(
+            jnp.concatenate(
+                [jnp.ones(s_m.shape[:-1] + (1,), jnp.int32),
+                 (s_m[..., 1:] != s_m[..., :-1]).astype(jnp.int32)], axis=-1),
+            axis=-1,
+        )
+        # count of each element's run, take the element ending the longest run
+        counts = jax.vmap(
+            lambda r: jnp.bincount(r, length=n + 1)[r],
+            in_axes=0, out_axes=0,
+        )(runs.reshape(-1, n)).reshape(runs.shape)
+        best = jnp.argmax(counts, axis=-1)
+        vals = jnp.take_along_axis(s_m, best[..., None], axis=-1)
+        idxs = jnp.take_along_axis(si_m, best[..., None], axis=-1)
+        if not keepdim:
+            vals, idxs = vals[..., 0], idxs[..., 0]
+        else:
+            vals = jnp.moveaxis(vals, -1, axis)
+            idxs = jnp.moveaxis(idxs, -1, axis)
+        return vals, idxs
+
+    return eager_call(
+        "mode", fn, [t], attrs={"axis": axis, "keepdim": bool(keepdim)},
+        differentiable=False,
+    )
+
+
+def poisson(x, name=None):
+    """Poisson-sample with rate tensor x (reference poisson_op)."""
+    from ..core import random as random_state
+
+    t = as_tensor(x)
+    key = random_state.next_key()
+    return Tensor(
+        jax.random.poisson(key, t._data.astype(jnp.float32)).astype(t._data.dtype),
+        stop_gradient=True,
+    )
+
+
+_PRINTOPTS = {"precision": 8, "threshold": 1000, "edgeitems": 3, "linewidth": 80}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    _PRINTOPTS.update(kw)
+    np.set_printoptions(**kw)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    """reference layers.create_parameter."""
+    from ..core.tensor import Parameter
+    from ..nn.initializer import Constant, Normal
+
+    init = default_initializer or (Constant(0.0) if is_bias else Normal(std=0.02))
+    data = jnp.zeros(tuple(int(s) for s in shape), dtype)
+    p = Parameter(data, name=name)
+    init(p)
+    return p
+
+
+def disable_signal_handler():
+    return None
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def get_cuda_rng_state():
+    from ..core.random import get_rng_state
+
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from ..core.random import set_rng_state
+
+    return set_rng_state(state)
+
+
+__all__ = [
+    "is_tensor", "is_floating_point", "is_integer", "is_complex", "is_empty",
+    "rank", "shape", "tolist", "reverse", "multiplex", "mode", "poisson",
+    "set_printoptions", "create_parameter", "disable_signal_handler",
+    "is_compiled_with_cinn", "is_compiled_with_rocm", "is_compiled_with_xpu",
+    "is_compiled_with_npu", "is_compiled_with_mlu", "is_compiled_with_ipu",
+    "get_cuda_rng_state", "set_cuda_rng_state",
+]

@@ -1,0 +1,59 @@
+"""Op registry — enumerates every registered op endpoint.
+
+The analogue of the reference's OpInfoMap (``paddle/fluid/framework/op_info.h``;
+`op_registry.h` registrations, ~913 incl. grad kernels). Grad ops need no
+separate registration here — every differentiable op's vjp comes from the
+tape — so the count below is of *forward* endpoints.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict
+
+
+def _module_fns(mod, prefix=""):
+    out = {}
+    for n in dir(mod):
+        if n.startswith("_"):
+            continue
+        fn = getattr(mod, n)
+        if callable(fn) and not inspect.isclass(fn) and inspect.getmodule(fn) in (mod, None):
+            out[prefix + n] = fn
+    return out
+
+
+def all_ops() -> Dict[str, Callable]:
+    """name -> callable for every registered op endpoint."""
+    from . import control_flow, creation, extra, generated, inplace, linalg, manipulation, math, misc
+    from .. import fft as fft_mod
+    from .. import signal as signal_mod
+    from ..nn import functional as F
+
+    ops: Dict[str, Callable] = {}
+    for mod in (math, manipulation, creation, linalg):
+        ops.update(_module_fns(mod))
+    ops.update({n: generated.GENERATED[n] for n in generated.GENERATED})
+    ops.update({n: getattr(extra, n) for n in extra.__all__})
+    ops.update({n: getattr(control_flow, n) for n in control_flow.__all__})
+    ops.update({n: getattr(misc, n) for n in misc.__all__})
+    ops.update({f"fft.{n}": getattr(fft_mod, n) for n in fft_mod.__all__})
+    ops.update({f"signal.{n}": getattr(signal_mod, n) for n in signal_mod.__all__})
+    ops.update({f"functional.{n}": v for n, v in _module_fns(F).items()})
+    for mod_name in ("activation", "common", "conv", "loss", "norm", "pooling",
+                     "attention", "vision"):
+        try:
+            sub = __import__(f"paddle_tpu.nn.functional.{mod_name}", fromlist=["x"])
+            ops.update({f"functional.{n}": v for n, v in _module_fns(sub).items()})
+        except ImportError:
+            pass
+    try:
+        from ..vision import ops as vops
+        ops.update({f"vision.{n}": v for n, v in _module_fns(vops).items()})
+    except ImportError:
+        pass
+    ops.update(inplace.INPLACE_OPS)
+    return ops
+
+
+def op_count() -> int:
+    return len(all_ops())

@@ -1,0 +1,133 @@
+"""paddle.signal — STFT family.
+
+Parity: reference ``python/paddle/signal.py`` (stft:183, istft:326, backed by
+frame/overlap_add ops in ``paddle/fluid/operators/``). TPU-native: framing is
+a gather, the transform is XLA's FFT HLO, overlap-add is a segment scatter —
+all fused under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import as_tensor, eager_call
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames (reference signal.py frame)."""
+    t = as_tensor(x)
+
+    def fn(a, frame_length=0, hop_length=0):
+        n = a.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        return jnp.moveaxis(a[..., idx], -2, -1)  # (..., frame_length, num)
+
+    return eager_call(
+        "signal.frame", fn, [t],
+        attrs={"frame_length": int(frame_length), "hop_length": int(hop_length)},
+    )
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference signal.py overlap_add)."""
+    t = as_tensor(x)
+
+    def fn(a, hop_length=0):
+        # (..., frame_length, num) -> (..., n)
+        fl, num = a.shape[-2], a.shape[-1]
+        n = (num - 1) * hop_length + fl
+        vals = jnp.moveaxis(a, -1, -2).reshape(a.shape[:-2] + (-1,))  # (..., num*fl)
+        # scatter-add each frame onto the output line
+        idx = (jnp.arange(num)[:, None] * hop_length + jnp.arange(fl)[None, :]).reshape(-1)
+        out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+        return out.at[..., idx].add(vals)
+
+    return eager_call("signal.overlap_add", fn, [t], attrs={"hop_length": int(hop_length)})
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (reference signal.py:183)."""
+    t = as_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    args = [t]
+    if window is not None:
+        args.append(as_tensor(window))
+
+    def fn(a, *w, n_fft=0, hop=0, win_length=0, center=True, pad_mode="reflect",
+           normalized=False, onesided=True):
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        n = a.shape[-1]
+        num = 1 + (n - n_fft) // hop
+        starts = jnp.arange(num) * hop
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = a[..., idx]  # (..., num, n_fft)
+        if w:
+            win = w[0]
+            if win_length < n_fft:
+                lpad = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+            frames = frames * win
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # (..., freq, num_frames)
+
+    return eager_call(
+        "signal.stft", fn, args,
+        attrs={"n_fft": int(n_fft), "hop": int(hop_length), "win_length": int(win_length),
+               "center": bool(center), "pad_mode": pad_mode,
+               "normalized": bool(normalized), "onesided": bool(onesided)},
+    )
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (reference signal.py:326)."""
+    t = as_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    args = [t]
+    if window is not None:
+        args.append(as_tensor(window))
+
+    def fn(spec, *w, n_fft=0, hop=0, win_length=0, center=True,
+           normalized=False, onesided=True, length=0):
+        spec = jnp.swapaxes(spec, -1, -2)  # (..., num, freq)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else jnp.fft.ifft(spec, axis=-1).real
+        if w:
+            win = w[0]
+            if win_length < n_fft:
+                lpad = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+        else:
+            win = jnp.ones((n_fft,), frames.dtype)
+        frames = frames * win
+        num = frames.shape[-2]
+        n = (num - 1) * hop + n_fft
+        idx2 = (jnp.arange(num)[:, None] * hop + jnp.arange(n_fft)[None, :]).reshape(-1)
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        out = out.at[..., idx2].add(frames.reshape(frames.shape[:-2] + (-1,)))
+        env = jnp.zeros((n,), frames.dtype).at[idx2].add(jnp.tile(win * win, num))
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length:
+            out = out[..., :length]
+        return out
+
+    return eager_call(
+        "signal.istft", fn, args,
+        attrs={"n_fft": int(n_fft), "hop": int(hop_length), "win_length": int(win_length),
+               "center": bool(center), "normalized": bool(normalized),
+               "onesided": bool(onesided), "length": int(length or 0)},
+    )
+
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]

@@ -1,0 +1,69 @@
+"""paddle.text — text domain API.
+
+Parity: reference ``python/paddle/text/`` (datasets + viterbi_decode op
+``paddle/fluid/operators/viterbi_decode_op.h``). Decode is a lax.scan DP —
+compiled, batch-vectorized.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import as_tensor, eager_call
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths, include_bos_eos_tag=True, name=None):
+    """Batched Viterbi (reference text/viterbi_decode.py -> viterbi_decode_op).
+
+    potentials: (B, T, N) emission scores; transition_params: (N, N);
+    lengths: (B,). Returns (scores (B,), paths (B, T)).
+    """
+    pt, tt, lt = as_tensor(potentials), as_tensor(transition_params), as_tensor(lengths)
+
+    def fn(emis, trans, lens, include=True):
+        B, T, N = emis.shape
+        start = emis[:, 0]
+        if include:
+            start = start + trans[-2, :N][None, :]  # BOS row
+
+        def step(carry, t):
+            alpha = carry  # (B, N)
+            scores = alpha[:, :, None] + trans[None, :N, :N] + emis[:, t][:, None, :]
+            best = jnp.max(scores, axis=1)
+            back = jnp.argmax(scores, axis=1)
+            # positions beyond each sequence's length keep their alpha
+            live = (t < lens)[:, None]
+            return jnp.where(live, best, alpha), back
+
+        alpha, backs = jax.lax.scan(step, start, jnp.arange(1, T))
+        if include:
+            alpha = alpha + trans[:N, -1][None, :]  # EOS column
+        last = jnp.argmax(alpha, axis=-1)
+        score = jnp.max(alpha, axis=-1)
+
+        def walk(carry, back_t):
+            tag, t = carry
+            live = (t < lens)
+            prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+            tag = jnp.where(live, prev, tag)
+            return (tag, t - 1), tag
+
+        (_, _), path_rev = jax.lax.scan(walk, (last, jnp.full((), T - 1)), backs[::-1])
+        paths = jnp.concatenate([path_rev[::-1].T, last[:, None]], axis=1)
+        return score, paths
+
+    return eager_call(
+        "viterbi_decode", fn, [pt, tt, lt],
+        attrs={"include": bool(include_bos_eos_tag)}, differentiable=False,
+    )
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths, self.include)

@@ -142,6 +142,260 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio, cli
     return out[0], out[1]
 
 
-class DeformConv2D:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("DeformConv2D: planned (gather-based Pallas kernel)")
+def _roi_batch_ids(boxes_num, n_rois):
+    """Per-RoI image index from the boxes_num split (reference RoisNum)."""
+    if boxes_num is None:
+        return np.zeros(n_rois, np.int32)
+    counts = np.asarray(as_tensor(boxes_num)._data).reshape(-1).astype(np.int64)
+    return np.repeat(np.arange(len(counts)), counts).astype(np.int32)[:n_rois]
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool each RoI to a fixed grid (reference detection/roi_pool_op):
+    every output cell is the max over a dense sample grid covering its bin."""
+    xt, bt = as_tensor(x), as_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = int(output_size[0]), int(output_size[1])
+    batch_ids = _roi_batch_ids(boxes_num, int(bt.shape[0]))
+    from ..core.tensor import Tensor as _T
+
+    bid_t = _T(jnp.asarray(batch_ids), stop_gradient=True)
+
+    S = 4  # samples per bin edge: max over S*S points approximates bin max
+
+    def fn(feat, rois, bids, oh=0, ow=0, scale=1.0):
+        N, C, H, W = feat.shape
+
+        def one_roi(roi, bid):
+            x1, y1, x2, y2 = roi * scale
+            # S dense samples inside each of the oh/ow bins
+            ys = y1 + (y2 - y1) * (jnp.arange(oh * S) + 0.5) / (oh * S)
+            xs = x1 + (x2 - x1) * (jnp.arange(ow * S) + 0.5) / (ow * S)
+            yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, W - 1)
+            v = feat[bid][:, yi][:, :, xi]  # (C, oh*S, ow*S)
+            return v.reshape(C, oh, S, ow, S).max(axis=(2, 4))
+
+        return jax.vmap(one_roi)(rois, bids)
+
+    return eager_call(
+        "roi_pool", fn, [xt, bt, bid_t],
+        attrs={"oh": oh, "ow": ow, "scale": float(spatial_scale)},
+    )
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI average pool (reference detection/psroi_pool_op)."""
+    xt, bt = as_tensor(x), as_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = int(output_size[0]), int(output_size[1])
+    batch_ids = _roi_batch_ids(boxes_num, int(bt.shape[0]))
+    from ..core.tensor import Tensor as _T
+
+    bid_t = _T(jnp.asarray(batch_ids), stop_gradient=True)
+    S = 4
+
+    def fn(feat, rois, bids, oh=0, ow=0, scale=1.0):
+        N, C, H, W = feat.shape
+        out_c = C // (oh * ow)
+
+        def one_roi(roi, bid):
+            x1, y1, x2, y2 = roi * scale
+            ys = y1 + (y2 - y1) * (jnp.arange(oh * S) + 0.5) / (oh * S)
+            xs = x1 + (x2 - x1) * (jnp.arange(ow * S) + 0.5) / (ow * S)
+            yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, W - 1)
+            f = feat[bid][:, yi][:, :, xi]  # (C, oh*S, ow*S)
+            f = f.reshape(out_c, oh, ow, oh, S, ow, S)
+
+            # position-sensitive: channel block (i,j) is averaged over bin (i,j)
+            def cell(i, j):
+                return f[:, i, j, i, :, j, :].mean(axis=(-1, -2))  # (out_c,)
+
+            grid = jax.vmap(lambda i: jax.vmap(lambda j: cell(i, j))(jnp.arange(ow)))(
+                jnp.arange(oh)
+            )  # (oh, ow, out_c)
+            return jnp.moveaxis(grid, -1, 0)
+
+        return jax.vmap(one_roi)(rois, bids)
+
+    return eager_call(
+        "psroi_pool", fn, [xt, bt, bid_t],
+        attrs={"oh": oh, "ow": ow, "scale": float(spatial_scale)},
+    )
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (reference detection/prior_box_op)."""
+    it, imt = as_tensor(input), as_tensor(image)
+    ratios = list(aspect_ratios)
+    if flip:
+        ratios = ratios + [1.0 / r for r in ratios if r != 1.0]
+
+    H, W = int(it.shape[-2]), int(it.shape[-1])
+    IH, IW = int(imt.shape[-2]), int(imt.shape[-1])
+    step_h = steps[1] or IH / H
+    step_w = steps[0] or IW / W
+
+    sizes = []
+    for k, ms in enumerate(min_sizes):
+        for r in ratios:
+            sizes.append((ms * (r ** 0.5), ms / (r ** 0.5)))
+        if max_sizes:
+            mx = max_sizes[k]
+            sizes.append(((ms * mx) ** 0.5, (ms * mx) ** 0.5))
+    sizes = np.asarray(sizes, np.float32)  # (P, 2) as (w, h)
+
+    cy = (np.arange(H) + offset) * step_h
+    cx = (np.arange(W) + offset) * step_w
+    gx, gy = np.meshgrid(cx, cy)
+    centers = np.stack([gx, gy], -1)[..., None, :]  # (H, W, 1, 2)
+    wh = sizes[None, None]  # (1, 1, P, 2)
+    mins = (centers - wh / 2) / np.asarray([IW, IH], np.float32)
+    maxs = (centers + wh / 2) / np.asarray([IW, IH], np.float32)
+    boxes = np.concatenate([mins, maxs], -1).astype(np.float32)  # (H, W, P, 4)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes), stop_gradient=True), Tensor(jnp.asarray(var), stop_gradient=True)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None, name=None):
+    """Assign RoIs to FPN levels (reference detection/distribute_fpn_proposals_op).
+    Host-side (dynamic shapes), like the reference's CPU kernel."""
+    rois = np.asarray(as_tensor(fpn_rois)._data)
+    w = rois[:, 2] - rois[:, 0] + (1 if pixel_offset else 0)
+    h = rois[:, 3] - rois[:, 1] + (1 if pixel_offset else 0)
+    scale = np.sqrt(np.maximum(w * h, 1e-6))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, nums, order = [], [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        outs.append(Tensor(jnp.asarray(rois[idx]), stop_gradient=True))
+        nums.append(Tensor(jnp.asarray(np.asarray([len(idx)], np.int32)), stop_gradient=True))
+        order.append(idx)
+    restore = np.argsort(np.concatenate(order)) if order else np.zeros(0, np.int64)
+    return outs, Tensor(jnp.asarray(restore.astype(np.int32)), stop_gradient=True), nums
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    """Deformable conv v1/v2 (reference operators/deformable_conv_op.cu):
+    bilinear-sample the input at offset-shifted taps, then contract — a
+    gather + matmul that XLA fuses; the MXU does the contraction."""
+    xt, ot, wt = as_tensor(x), as_tensor(offset), as_tensor(weight)
+    args = [xt, ot, wt]
+    if mask is not None:
+        args.append(as_tensor(mask))
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    kh, kw = int(wt.shape[-2]), int(wt.shape[-1])
+
+    def fn(feat, off, w, *rest, sh=1, sw=1, ph=0, pw=0, dh=1, dw=1, kh=3, kw=3):
+        msk = rest[0] if rest else None
+        N, C, H, W = feat.shape
+        OC = w.shape[0]
+        OH = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        OW = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        feat_p = jnp.pad(feat, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        Hp, Wp = H + 2 * ph, W + 2 * pw
+        # offsets: (N, dg*kh*kw*2, OH, OW) interleaved (dy, dx) PER TAP —
+        # the reference/mmcv layout (deformable_conv_op channel order)
+        dg = off.shape[1] // (2 * kh * kw)
+        off = off.reshape(N, dg, kh * kw, 2, OH, OW)
+        cpg = C // dg  # channels per deformable group
+
+        def sample(feat_n, off_n, msk_n):
+            def group_sample(feat_g, off_g, msk_g):
+                # feat_g (cpg, Hp, Wp); off_g (kh*kw, 2, OH, OW); msk_g
+                # (kh*kw, OH, OW) or () sentinel
+                dy = off_g[:, 0].reshape(kh, kw, OH, OW)
+                dx = off_g[:, 1].reshape(kh, kw, OH, OW)
+                # tap positions per (kh, kw, OH, OW)
+                yy = (jnp.arange(OH) * sh)[None, None, :, None] + (jnp.arange(kh) * dh)[:, None, None, None] + dy
+                xx = (jnp.arange(OW) * sw)[None, None, None, :] + (jnp.arange(kw) * dw)[None, :, None, None] + dx
+                y0 = jnp.floor(yy)
+                x0 = jnp.floor(xx)
+                wy = yy - y0
+                wx = xx - x0
+
+                def gat(yi, xi):
+                    inb = (yi >= 0) & (yi < Hp) & (xi >= 0) & (xi < Wp)
+                    v = feat_g[:, jnp.clip(yi, 0, Hp - 1), jnp.clip(xi, 0, Wp - 1)]
+                    return jnp.where(inb[None], v, 0.0)
+
+                y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+                v = (gat(y0i, x0i) * (1 - wy) * (1 - wx) + gat(y0i, x0i + 1) * (1 - wy) * wx
+                     + gat(y0i + 1, x0i) * wy * (1 - wx) + gat(y0i + 1, x0i + 1) * wy * wx)
+                if msk_g.ndim:
+                    v = v * msk_g.reshape(kh, kw, OH, OW)[None]
+                return v  # (cpg, kh, kw, OH, OW)
+
+            feat_grp = feat_n.reshape(dg, cpg, Hp, Wp)
+            msk_grp = (
+                msk_n.reshape(dg, kh * kw, OH, OW)
+                if msk_n.ndim else jnp.broadcast_to(msk_n, (dg,))
+            )
+            v = jax.vmap(group_sample)(feat_grp, off_n, msk_grp)
+            return v.reshape(C, kh, kw, OH, OW)
+
+        if msk is not None:
+            cols = jax.vmap(sample)(feat_p, off, msk)
+        else:
+            zero = jnp.zeros(())  # 0-d sentinel: "no mask"
+            cols = jax.vmap(lambda f, o: sample(f, o, zero))(feat_p, off)
+        return jnp.einsum("nckhij,ockh->noij", cols.reshape(N, C, kh, kw, OH, OW), w)
+
+    out = eager_call(
+        "deform_conv2d", fn, args,
+        attrs={"sh": stride[0], "sw": stride[1], "ph": padding[0], "pw": padding[1],
+               "dh": dilation[0], "dw": dilation[1], "kh": kh, "kw": kw},
+    )
+    if bias is not None:
+        out = out + as_tensor(bias).reshape([1, -1, 1, 1])
+    return out
+
+
+def _make_deform_conv_layer():
+    from ..nn.layer.layers import Layer
+
+    class DeformConv2D(Layer):
+        """Layer over deform_conv2d (reference vision/ops.py DeformConv2D);
+        parameters register through the Layer machinery so optimizers and
+        state_dict see them."""
+
+        def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                     padding=0, dilation=1, deformable_groups=1, groups=1,
+                     weight_attr=None, bias_attr=None):
+            super().__init__()
+            k = kernel_size if isinstance(kernel_size, (tuple, list)) else (kernel_size, kernel_size)
+            self.weight = self.create_parameter(
+                [out_channels, in_channels // groups, k[0], k[1]], attr=weight_attr
+            )
+            self.bias = (
+                None if bias_attr is False
+                else self.create_parameter([out_channels], attr=bias_attr, is_bias=True)
+            )
+            self.stride, self.padding, self.dilation = stride, padding, dilation
+            self.deformable_groups, self.groups = deformable_groups, groups
+
+        def forward(self, x, offset, mask=None):
+            return deform_conv2d(
+                x, offset, self.weight, self.bias, self.stride, self.padding,
+                self.dilation, self.deformable_groups, self.groups, mask,
+            )
+
+    return DeformConv2D
+
+
+DeformConv2D = _make_deform_conv_layer()

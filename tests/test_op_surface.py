@@ -1,0 +1,244 @@
+"""New op-surface modules: fft, signal, control flow, detection ops, text,
+misc — numeric checks vs numpy/brute-force references.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.randn(2, 16).astype(np.float32)
+        X = paddle.fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(X._data), np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        back = paddle.fft.ifft(X)
+        np.testing.assert_allclose(np.asarray(back._data).real, x, rtol=1e-4, atol=1e-5)
+
+    def test_rfft_grad(self):
+        x = paddle.to_tensor(np.random.randn(8).astype(np.float32), stop_gradient=False)
+        y = paddle.fft.irfft(paddle.fft.rfft(x))
+        y.sum().backward()
+        assert x.grad is not None
+
+    def test_fftshift(self):
+        x = np.arange(8, dtype=np.float32)
+        out = paddle.fft.fftshift(paddle.to_tensor(x))
+        np.testing.assert_array_equal(out.numpy(), np.fft.fftshift(x))
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        x = np.random.randn(2, 128).astype(np.float32)
+        s = paddle.signal.stft(paddle.to_tensor(x), n_fft=32, hop_length=8)
+        rec = paddle.signal.istft(s, n_fft=32, hop_length=8, length=128)
+        np.testing.assert_allclose(rec.numpy(), x, atol=1e-5)
+
+    def test_frame_overlap_add(self):
+        x = np.arange(20, dtype=np.float32)
+        fr = paddle.signal.frame(paddle.to_tensor(x), 4, 4)
+        back = paddle.signal.overlap_add(fr, 4)
+        np.testing.assert_allclose(back.numpy(), x[: back.shape[-1]])
+
+
+class TestControlFlow:
+    def test_cond_eager_and_traced(self):
+        from paddle_tpu.ops.control_flow import cond
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out = cond(paddle.to_tensor(True), lambda: x + 1, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [2.0, 3.0])
+
+        # traced through to_static
+        @paddle.jit.to_static
+        def f(a, flag):
+            return cond(flag > 0, lambda: a * 2, lambda: a * 3)
+
+        r = f(x, paddle.to_tensor(np.array(1.0, np.float32)))
+        np.testing.assert_allclose(r.numpy(), [2.0, 4.0])
+        r2 = f(x, paddle.to_tensor(np.array(-1.0, np.float32)))
+        np.testing.assert_allclose(r2.numpy(), [3.0, 6.0])
+
+    def test_while_loop_eager_and_traced(self):
+        from paddle_tpu.ops.control_flow import while_loop
+
+        out = while_loop(lambda i: i < 10, lambda i: i + 3, [paddle.to_tensor(0)])
+        assert int(out[0].numpy()) == 12
+
+        @paddle.jit.to_static
+        def f(n):
+            res = while_loop(lambda i, acc: i < 5, lambda i, acc: (i + 1, acc + n), [paddle.to_tensor(0), paddle.to_tensor(np.float32(0))])
+            return res[1]
+
+        r = f(paddle.to_tensor(np.float32(2.0)))
+        assert float(r.numpy()) == 10.0
+
+    def test_switch_case(self):
+        from paddle_tpu.ops.control_flow import switch_case
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        out = switch_case(paddle.to_tensor(1), [lambda: x * 10, lambda: x * 20, lambda: x * 30])
+        np.testing.assert_allclose(out.numpy(), [20.0])
+
+
+class TestDetectionOps:
+    def test_roi_align_and_pool_shapes(self):
+        from paddle_tpu.vision.ops import roi_align, roi_pool
+
+        feat = paddle.to_tensor(np.random.randn(1, 3, 8, 8).astype(np.float32))
+        boxes = paddle.to_tensor(np.array([[0, 0, 7, 7], [2, 2, 6, 6]], np.float32))
+        ra = roi_align(feat, boxes, None, 4)
+        rp = roi_pool(feat, boxes, None, 4)
+        assert list(ra.shape) == [2, 3, 4, 4]
+        assert list(rp.shape) == [2, 3, 4, 4]
+
+    def test_roi_pool_max_semantics(self):
+        from paddle_tpu.vision.ops import roi_pool
+
+        feat = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        out = roi_pool(feat, paddle.to_tensor(np.array([[0, 0, 3, 3]], np.float32)), None, 2)
+        # true max-pool of the full RoI into 2x2 bins
+        np.testing.assert_allclose(out.numpy()[0, 0], [[5.0, 7.0], [13.0, 15.0]])
+
+    def test_roi_pool_batch_ids(self):
+        from paddle_tpu.vision.ops import roi_pool
+
+        feat = np.zeros((2, 1, 4, 4), np.float32)
+        feat[1] = 1.0
+        boxes = paddle.to_tensor(np.array([[0, 0, 3, 3], [0, 0, 3, 3]], np.float32))
+        nums = paddle.to_tensor(np.array([1, 1], np.int32))
+        out = roi_pool(paddle.to_tensor(feat), boxes, nums, 2)
+        assert out.numpy()[0].max() == 0.0 and out.numpy()[1].min() == 1.0
+
+    def test_deform_conv_offset_layout(self):
+        """Interleaved (dy,dx)-per-tap layout: dx of tap0 shifts sampling
+        right by one column (reference/mmcv channel order)."""
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        w = paddle.to_tensor(np.ones((1, 1, 1, 1), np.float32))
+        off = np.zeros((1, 2, 4, 4), np.float32)
+        off[:, 1] = 1.0  # dx of the single tap
+        out = deform_conv2d(x, paddle.to_tensor(off), w)
+        ref = np.arange(16, dtype=np.float32).reshape(4, 4)
+        shifted = np.concatenate([ref[:, 1:], np.zeros((4, 1), np.float32)], axis=1)
+        np.testing.assert_allclose(out.numpy()[0, 0], shifted, atol=1e-5)
+
+    def test_switch_case_negative_default(self):
+        from paddle_tpu.ops.control_flow import switch_case
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        out = switch_case(
+            paddle.to_tensor(-1), [lambda: x * 10, lambda: x * 20], default=lambda: x * 99
+        )
+        np.testing.assert_allclose(out.numpy(), [99.0])
+
+    def test_deform_conv_layer_params(self):
+        from paddle_tpu.vision.ops import DeformConv2D
+
+        layer = DeformConv2D(2, 4, 3)
+        names = [n for n, _ in layer.named_parameters()]
+        assert "weight" in names and "bias" in names
+        assert DeformConv2D(2, 4, 3, bias_attr=False).bias is None
+
+    def test_deform_conv_zero_offset_equals_conv(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        x = paddle.to_tensor(np.random.randn(1, 2, 6, 6).astype(np.float32))
+        w = paddle.to_tensor(np.random.randn(3, 2, 3, 3).astype(np.float32) * 0.2)
+        off = paddle.to_tensor(np.zeros((1, 18, 4, 4), np.float32))
+        np.testing.assert_allclose(
+            deform_conv2d(x, off, w).numpy(), F.conv2d(x, w).numpy(), rtol=1e-4, atol=1e-4
+        )
+
+    def test_prior_box_and_fpn(self):
+        from paddle_tpu.vision.ops import distribute_fpn_proposals, prior_box
+
+        pb, var = prior_box(
+            paddle.to_tensor(np.zeros((1, 1, 4, 4), np.float32)),
+            paddle.to_tensor(np.zeros((1, 1, 32, 32), np.float32)),
+            min_sizes=[8.0], aspect_ratios=[1.0],
+        )
+        assert list(pb.shape) == [4, 4, 1, 4]
+        rois = paddle.to_tensor(np.array([[0, 0, 10, 10], [0, 0, 100, 100]], np.float32))
+        outs, restore, nums = distribute_fpn_proposals(rois, 2, 5, 4, 224)
+        assert sum(int(o.shape[0]) for o in outs) == 2
+
+
+class TestText:
+    def test_viterbi_brute_force(self):
+        import itertools
+
+        emis = np.random.RandomState(3).randn(1, 4, 3).astype(np.float32)
+        trans = np.random.RandomState(4).randn(5, 5).astype(np.float32)
+        sc, path = paddle.text.viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans), paddle.to_tensor(np.array([4]))
+        )
+        best, bp = -1e30, None
+        for seq in itertools.product(range(3), repeat=4):
+            s = trans[-2, seq[0]] + emis[0, 0, seq[0]]
+            for k in range(1, 4):
+                s += trans[seq[k - 1], seq[k]] + emis[0, k, seq[k]]
+            s += trans[seq[-1], -1]
+            if s > best:
+                best, bp = s, seq
+        assert abs(best - float(sc.numpy()[0])) < 1e-4
+        assert list(bp) == list(path.numpy()[0])
+
+
+class TestMisc:
+    def test_mode_multiplex_rank(self):
+        x = paddle.to_tensor(np.array([[1.0, 1.0, 2.0], [3.0, 4.0, 4.0]], np.float32))
+        v, i = paddle.mode(x)
+        np.testing.assert_allclose(v.numpy(), [1.0, 4.0])
+        idx = paddle.to_tensor(np.array([1, 0]))
+        out = paddle.multiplex([x, x + 10], idx)
+        np.testing.assert_allclose(out.numpy()[0], [11.0, 11.0, 12.0])
+        assert int(paddle.rank(x).numpy()) == 2
+        assert paddle.is_tensor(x) and paddle.is_floating_point(x)
+
+    def test_inplace_variants(self):
+        y = paddle.to_tensor(np.array([1.0, 4.0], np.float32))
+        y.sqrt_()
+        np.testing.assert_allclose(y.numpy(), [1.0, 2.0])
+        y.fill_(7.0)
+        np.testing.assert_allclose(y.numpy(), [7.0, 7.0])
+        z = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            z.exp_()
+
+    def test_grid_sample_grad(self):
+        x = paddle.to_tensor(np.random.randn(1, 2, 4, 4).astype(np.float32), stop_gradient=False)
+        theta = paddle.to_tensor(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32))
+        g = F.affine_grid(theta, [1, 2, 4, 4])
+        out = F.grid_sample(x, g)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-5)
+        out.sum().backward()
+        assert x.grad is not None
+
+    def test_hsigmoid_margin_ce(self):
+        lab = paddle.to_tensor(np.array([0, 1, 2]))
+        xh = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32), stop_gradient=False)
+        wh = paddle.to_tensor(np.random.randn(7, 4).astype(np.float32))
+        hl = F.hsigmoid_loss(xh, lab, 8, wh)
+        assert list(hl.shape) == [3, 1]  # per-sample, reference shape
+        hl.mean().backward()
+        assert xh.grad is not None
+        logits = paddle.to_tensor(
+            (np.random.rand(3, 8).astype(np.float32) - 0.5) * 1.6, stop_gradient=False
+        )
+        F.margin_cross_entropy(logits, lab).backward()
+        assert logits.grad is not None
+
+    def test_einsum_segment(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+        out = paddle.einsum("ij,kj->ik", x, x)
+        np.testing.assert_allclose(out.numpy(), x.numpy() @ x.numpy().T, rtol=1e-5)
+        out.sum().backward()
+        assert x.grad is not None
+        seg = paddle.segment_mean(
+            paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32)),
+            paddle.to_tensor(np.array([0, 0, 1])),
+        )
+        np.testing.assert_allclose(seg.numpy(), [[2.0, 3.0], [5.0, 6.0]])

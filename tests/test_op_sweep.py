@@ -1,0 +1,159 @@
+"""Generated-op sweep — the OpTest battery over the yaml op table.
+
+Methodology per reference ``unittests/op_test.py:282``: every registered
+generated op gets (1) an fp32 forward execution with finite outputs, (2) a
+bf16 forward smoke for float ops, (3) a central finite-difference gradient
+check against the autograd tape for differentiable ops. Op-specific input
+domains/shapes come from the spec metadata (ops.yaml), so newly added yaml
+entries are tested automatically.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.generated import GENERATED, SPECS
+
+_SHAPES = {"sq": (4, 4), "vec": (6,), None: (2, 3)}
+
+
+def _shape_of(val):
+    if val is None:
+        return _SHAPES[None]
+    if isinstance(val, str):
+        return _SHAPES.get(val, _SHAPES[None])
+    return tuple(val)
+
+
+def _sample(domain, shape, rng):
+    if domain == "pos":
+        return (rng.rand(*shape) + 0.5).astype(np.float32)
+    if domain == "unit":
+        return (rng.rand(*shape) * 0.8 + 0.1).astype(np.float32)
+    if domain == "smallint":
+        return rng.randint(0, 3, shape).astype(np.int32)
+    if domain == "index":
+        return rng.randint(0, 2, shape).astype(np.int32)
+    return rng.randn(*shape).astype(np.float32)
+
+
+# bespoke inputs where the generic sampler can't satisfy op preconditions
+def _custom_inputs(name, rng):
+    if name == "bucketize":
+        return [rng.randn(2, 3).astype(np.float32), np.sort(rng.randn(5).astype(np.float32))]
+    if name == "isin":
+        return [rng.randint(0, 4, (2, 3)).astype(np.int32), rng.randint(0, 4, (4,)).astype(np.int32)]
+    if name == "argwhere":
+        return [(rng.rand(2, 3) > 0.5).astype(np.float32)]
+    if name == "matrix_exp":
+        return [(rng.randn(3, 3) * 0.1).astype(np.float32)]
+    if name in ("matrix_norm", "lu_unpack"):
+        return [rng.randn(3, 3).astype(np.float32)]
+    return None
+
+
+def _inputs_for(spec, rng):
+    custom = _custom_inputs(spec["name"], rng)
+    if custom is not None:
+        return custom
+    args = spec.get("args", ["x"])
+    if spec.get("variadic"):
+        sh = _shape_of(spec.get("shape"))
+        return [_sample(spec.get("domain"), sh, rng) for _ in range(2)]
+    inputs = []
+    for i in range(len(args)):
+        sh = _shape_of(spec.get("shape" if i == 0 else f"shape{i + 1}", spec.get("shape")))
+        dom = spec.get("domain" if i == 0 else f"domain{i + 1}", spec.get("domain"))
+        inputs.append(_sample(dom, sh, rng))
+    return inputs
+
+
+def _runnable_specs():
+    out = []
+    for name, spec in sorted(SPECS.items()):
+        if spec.get("skip_test") or spec.get("alias_of"):
+            continue
+        out.append(name)
+    return out
+
+
+@pytest.mark.parametrize("name", _runnable_specs())
+def test_forward_fp32(name):
+    spec = SPECS[name]
+    rng = np.random.RandomState(7)
+    inputs = _inputs_for(spec, rng)
+    op = GENERATED[name]
+    if spec.get("variadic"):
+        out = op(inputs)
+    else:
+        out = op(*[paddle.to_tensor(a) for a in inputs])
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        a = np.asarray(o.numpy())
+        if np.issubdtype(a.dtype, np.floating):
+            assert np.isfinite(a).all(), f"{name} produced non-finite fp32 output"
+
+
+@pytest.mark.parametrize(
+    "name",
+    [n for n in _runnable_specs()
+     if SPECS[n].get("grad", True) and SPECS[n].get("bf16", True)
+     and not SPECS[n].get("variadic")
+     and SPECS[n].get("args", ["x"]) and SPECS[n].get("domain") not in ("smallint", "index")],
+)
+def test_forward_bf16(name):
+    """Float ops must run in bf16 (the MXU-native dtype)."""
+    import jax.numpy as jnp
+
+    spec = SPECS[name]
+    rng = np.random.RandomState(8)
+    inputs = _inputs_for(spec, rng)
+    if any(np.issubdtype(np.asarray(a).dtype, np.integer) for a in inputs):
+        pytest.skip("integer-input op")
+    tensors = [paddle.to_tensor(a).astype("bfloat16") for a in inputs]
+    out = GENERATED[name](*tensors)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    assert all(o.numpy() is not None for o in outs)
+
+
+_GRAD_EXCLUDE = {
+    # piecewise-constant or argsort-coupled outputs: analytic grad is 0/ok but
+    # finite differences step across discontinuities
+    "fix", "msort", "unwrap", "renorm", "nanmedian", "nanquantile", "diff",
+}
+
+
+@pytest.mark.parametrize(
+    "name",
+    [n for n in _runnable_specs()
+     if SPECS[n].get("grad", True) and not SPECS[n].get("variadic")
+     and SPECS[n].get("args", ["x"]) and n not in _GRAD_EXCLUDE
+     and SPECS[n].get("domain") not in ("smallint", "index")
+     and not any(SPECS[n].get(f"domain{i}") in ("smallint", "index") for i in (2, 3))],
+)
+def test_grad_check(name):
+    """Central finite difference vs the autograd tape (op_test.check_grad)."""
+    from op_test import check_grad
+
+    spec = SPECS[name]
+    rng = np.random.RandomState(9)
+    inputs = _inputs_for(spec, rng)
+    n_tensor = len(spec.get("args", ["x"]))
+    out_index = 0 if spec.get("n_outs") in (2, "list") else None
+    nondiff = set(spec.get("nondiff", ()))
+    if out_index == 0 and 0 in nondiff:
+        pytest.skip("first output non-differentiable")
+    check_grad(
+        GENERATED[name], inputs[:n_tensor],
+        grad_inputs=[i for i in range(n_tensor)
+                     if not np.issubdtype(np.asarray(inputs[i]).dtype, np.integer)],
+        out_index=out_index, atol=5e-2, rtol=5e-2,
+    )
+
+
+def test_registry_count():
+    """SURVEY §2.2 coverage gate: the registered forward-op surface keeps
+    growing toward the reference's (913 registrations incl. grad kernels;
+    grads are implicit here)."""
+    from paddle_tpu.ops.registry import op_count
+
+    assert op_count() >= 500, op_count()
