@@ -1,0 +1,165 @@
+"""Elastic training — fault detection, heartbeats, scale events, relaunch.
+
+Parity: reference ``python/paddle/distributed/fleet/elastic/manager.py``
+(ElasticManager:130 — etcd heartbeats, np scaling, watch loop → relaunch) and
+``collective.py`` (worker registration). TPU-native: the KV substrate is our
+C++ TCPStore (the coordination-service analogue of the reference's etcd), so
+no external dependency; the watch loop drives the launcher's restart policy.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from enum import Enum
+from typing import Callable, Dict, List, Optional
+
+
+class ElasticStatus(Enum):
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"       # membership stable
+    RESTART = "restart" # membership changed: relaunch with new world
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Heartbeat-based membership tracking over a TCPStore.
+
+    Workers call ``register()`` (spawns a heartbeat thread); the launcher-side
+    watcher calls ``watch()`` each interval and reacts to scale events —
+    the reference manager.py watch/_match/_update_hosts loop, minus etcd.
+    """
+
+    PREFIX = "elastic"
+
+    def __init__(
+        self,
+        store,
+        np_target: int,
+        worker_id: Optional[str] = None,
+        heartbeat_interval: float = 1.0,
+        timeout: float = 5.0,
+        min_np: Optional[int] = None,
+        max_np: Optional[int] = None,
+    ):
+        self.store = store
+        self.np_target = int(np_target)
+        self.min_np = int(min_np or np_target)
+        self.max_np = int(max_np or np_target)
+        self.worker_id = worker_id
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.timeout = float(timeout)
+        self._hb_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._last_world: Optional[List[str]] = None
+
+    # -- worker side -------------------------------------------------------
+    def _hb_key(self, wid):
+        return f"{self.PREFIX}/hb/{wid}"
+
+    def register(self):
+        """Join the membership and start heartbeating (reference
+        collective.py worker register + manager heartbeat thread)."""
+        assert self.worker_id is not None, "worker_id required to register"
+        self.store.add(f"{self.PREFIX}/registered", 1)
+        self._beat()
+        self._stop.clear()
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+        return self
+
+    def _beat(self):
+        self.store.set(self._hb_key(self.worker_id), json.dumps({"ts": time.time()}))
+
+    def _hb_loop(self):
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._beat()
+            except Exception:
+                return  # store gone: let the watcher declare us dead
+
+    def deregister(self):
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+            self._hb_thread = None
+        try:
+            self.store.delete_key(self._hb_key(self.worker_id))
+        except Exception:
+            pass
+
+    # -- watcher side ------------------------------------------------------
+    def alive_workers(self, known_ids: List[str]) -> List[str]:
+        now = time.time()
+        alive = []
+        for wid in known_ids:
+            raw = self.store.get(self._hb_key(wid))
+            if not raw:
+                continue
+            try:
+                ts = json.loads(raw)["ts"]
+            except Exception:
+                continue
+            if now - ts <= self.timeout:
+                alive.append(wid)
+        return alive
+
+    def watch(self, known_ids: List[str]) -> ElasticStatus:
+        """One watch tick (reference manager.py:398 watch loop)."""
+        alive = self.alive_workers(known_ids)
+        if self._last_world is None:
+            self._last_world = alive
+        if len(alive) == 0:
+            return ElasticStatus.EXIT
+        if len(alive) < self.min_np:
+            # below the floor: fault — wait for relaunch
+            self._last_world = alive
+            return ElasticStatus.ERROR
+        if set(alive) != set(self._last_world):
+            self._last_world = alive
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def world(self) -> List[str]:
+        return list(self._last_world or [])
+
+
+class ElasticLauncher:
+    """Supervise worker processes with elastic restarts (reference
+    fleet/launch.py elastic mode + manager relaunch)."""
+
+    def __init__(self, spawn_fn: Callable[[List[str]], Dict[str, object]],
+                 manager: ElasticManager, watch_interval: float = 1.0,
+                 max_restarts: int = 3):
+        self.spawn_fn = spawn_fn
+        self.manager = manager
+        self.watch_interval = watch_interval
+        self.max_restarts = max_restarts
+
+    def run(self, worker_ids: List[str]):
+        restarts = 0
+        procs = self.spawn_fn(worker_ids)
+        while True:
+            time.sleep(self.watch_interval)
+            # process exits take precedence over heartbeat staleness
+            codes = {w: p.poll() for w, p in procs.items()}
+            if all(c == 0 for c in codes.values()):
+                return 0
+            failed = [w for w, c in codes.items() if c not in (None, 0)]
+            status = self.manager.watch(worker_ids)
+            if failed or status in (ElasticStatus.RESTART, ElasticStatus.ERROR):
+                restarts += 1
+                if restarts > self.max_restarts:
+                    for p in procs.values():
+                        if p.poll() is None:
+                            p.terminate()
+                    raise RuntimeError(
+                        f"elastic: exceeded max_restarts={self.max_restarts}; failed={failed}"
+                    )
+                for p in procs.values():
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs.values():
+                    p.wait()
+                procs = self.spawn_fn(worker_ids)

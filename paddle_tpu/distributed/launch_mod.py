@@ -1,58 +1,205 @@
 """Multi-host launcher.
 
 Parity: reference ``python -m paddle.distributed.launch``
-(``fleet/launch.py``: Cluster/Pod topology, endpoint assignment, proc
-supervision). TPU-native: one process per HOST (not per chip); each process
-calls jax.distributed.initialize against a coordinator and sees its local
-chips; XLA handles cross-host DCN. This module supervises those per-host
-processes on the current node.
+(``fleet/launch.py`` + ``launch_utils.py:272`` get_cluster_from_args —
+Cluster/Pod/Trainer topology, endpoint assignment, log redirection, proc
+supervision; elastic relaunch via ``fleet/elastic``). TPU-native process
+model: ONE worker process per HOST (not per chip) — each calls
+``jax.distributed.initialize`` against the coordinator and owns its local
+chips; XLA routes cross-host collectives over ICI/DCN. This module builds
+the cluster topology from ``--ips``/env, supervises this node's workers, and
+(elastic mode) restarts on failure with heartbeat-based fault detection.
 """
 from __future__ import annotations
 
 import os
 import subprocess
 import sys
-import time
+from dataclasses import dataclass, field
+from typing import List, Optional
 
 
-def launch(training_script, training_script_args=None, hosts=None, coordinator_port=8476, nproc_per_node=1, log_dir=None):
-    """Launch `nproc_per_node` worker processes on this node."""
+@dataclass
+class Trainer:
+    endpoint: str
+    rank: int
+    local_rank: int
+
+
+@dataclass
+class Pod:
+    """One host's workers (reference launch_utils.py Pod)."""
+
+    addr: str
+    node_rank: int
+    trainers: List[Trainer] = field(default_factory=list)
+
+
+@dataclass
+class Cluster:
+    """The whole-job topology (reference launch_utils.py Cluster)."""
+
+    pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def world_size(self):
+        return sum(len(p.trainers) for p in self.pods)
+
+    def trainer_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def pod_by_addr(self, addr):
+        for p in self.pods:
+            if p.addr == addr:
+                return p
+        return None
+
+
+def get_cluster(ips: List[str], nproc_per_node: int, base_port: int = 8476) -> Cluster:
+    """Build the topology (reference launch_utils.py get_cluster:272)."""
+    cluster = Cluster()
+    rank = 0
+    for node_rank, ip in enumerate(ips):
+        pod = Pod(addr=ip, node_rank=node_rank)
+        for local in range(nproc_per_node):
+            pod.trainers.append(
+                Trainer(endpoint=f"{ip}:{base_port + 1 + local}", rank=rank, local_rank=local)
+            )
+            rank += 1
+        cluster.pods.append(pod)
+    return cluster
+
+
+def _current_node_ip(ips: List[str]) -> str:
+    explicit = os.environ.get("PADDLE_CURRENT_NODE") or os.environ.get("POD_IP")
+    if explicit and explicit in ips:
+        return explicit
+    nr = os.environ.get("PADDLE_NODE_RANK")
+    if nr is not None and int(nr) < len(ips):
+        return ips[int(nr)]
+    import socket
+
+    names = {"127.0.0.1", "localhost", socket.gethostname()}
+    try:
+        names.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    for ip in ips:
+        if ip in names:
+            return ip
+    if len(ips) == 1:
+        return ips[0]
+    # multi-node with no identity match: guessing node 0 would duplicate
+    # ranks across hosts — demand explicit identification instead
+    raise RuntimeError(
+        f"cannot identify this host among --ips {ips}; set PADDLE_CURRENT_NODE "
+        "or PADDLE_NODE_RANK"
+    )
+
+
+def launch(
+    training_script: str,
+    training_script_args: Optional[List[str]] = None,
+    ips: str = "127.0.0.1",
+    nproc_per_node: int = 1,
+    coordinator_port: int = 8476,
+    log_dir: Optional[str] = None,
+    elastic: bool = False,
+    max_restarts: int = 3,
+    hosts=None,
+):
+    """Launch this node's workers per the cluster topology; supervise them.
+
+    Multi-node: run the same command on every host in ``ips`` — each node
+    starts only its own pod's processes (reference launch.py behavior).
+    """
     training_script_args = training_script_args or []
-    procs = []
-    n = int(nproc_per_node)
-    coordinator = f"127.0.0.1:{coordinator_port}"
-    for rank in range(n):
-        env = dict(os.environ)
-        env.update(
-            {
-                "PADDLE_TRAINER_ID": str(rank),
-                "PADDLE_LOCAL_RANK": str(rank),
-                "PADDLE_TRAINERS_NUM": str(n),
-                "PADDLE_TPU_COORDINATOR": coordinator,
-                "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{coordinator_port + rank}",
-                "PADDLE_TRAINER_ENDPOINTS": ",".join(
-                    f"127.0.0.1:{coordinator_port + i}" for i in range(n)
-                ),
-            }
+    if hosts is not None:  # backwards-compatible alias
+        ips = hosts if isinstance(hosts, str) else ",".join(hosts)
+    ip_list = [s.strip() for s in str(ips).split(",") if s.strip()]
+    cluster = get_cluster(ip_list, int(nproc_per_node), coordinator_port)
+    me = _current_node_ip(ip_list)
+    pod = cluster.pod_by_addr(me)
+    if pod is None:
+        raise RuntimeError(f"current node {me} not in --ips {ip_list}")
+    coordinator = f"{ip_list[0]}:{coordinator_port}"
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    def spawn_all(_ids=None, _elastic_port=None):
+        procs = {}
+        for t in pod.trainers:
+            env = dict(os.environ)
+            env.update(
+                {
+                    "PADDLE_TRAINER_ID": str(t.rank),
+                    "PADDLE_LOCAL_RANK": str(t.local_rank),
+                    "PADDLE_TRAINERS_NUM": str(cluster.world_size),
+                    "PADDLE_TPU_COORDINATOR": coordinator,
+                    "PADDLE_CURRENT_ENDPOINT": t.endpoint,
+                    "PADDLE_TRAINER_ENDPOINTS": ",".join(cluster.trainer_endpoints()),
+                    "PADDLE_NODE_RANK": str(pod.node_rank),
+                    "PADDLE_NNODES": str(len(cluster.pods)),
+                }
+            )
+            if _elastic_port is not None:
+                # workers auto-register heartbeats in init_parallel_env
+                env["PADDLE_ELASTIC_STORE"] = f"{ip_list[0]}:{_elastic_port}"
+                env["PADDLE_ELASTIC_WORKER_ID"] = f"w{t.rank}"
+            stdout = (
+                open(os.path.join(log_dir, f"worker.{t.rank}.log"), "ab")
+                if log_dir else None
+            )
+            p = subprocess.Popen(
+                [sys.executable, training_script] + list(training_script_args),
+                env=env, stdout=stdout, stderr=subprocess.STDOUT if stdout else None,
+            )
+            if stdout is not None:
+                stdout.close()  # child holds its own copy of the fd
+            procs[f"w{t.rank}"] = p
+        return procs
+
+    if elastic:
+        from . import TCPStore
+        from .fleet.elastic import ElasticLauncher, ElasticManager
+
+        elastic_port = coordinator_port - 1
+        store = TCPStore(
+            host=ip_list[0], port=elastic_port,
+            is_master=(pod.node_rank == 0),
         )
-        p = subprocess.Popen([sys.executable, training_script] + list(training_script_args), env=env)
-        procs.append(p)
-    codes = [p.wait() for p in procs]
-    if any(codes):
+        manager = ElasticManager(store, cluster.world_size, timeout=10.0)
+        launcher = ElasticLauncher(
+            lambda ids: spawn_all(ids, _elastic_port=elastic_port),
+            manager, max_restarts=max_restarts,
+        )
+        return launcher.run([f"w{t.rank}" for t in pod.trainers])
+
+    procs = spawn_all()
+    codes = {w: p.wait() for w, p in procs.items()}
+    if any(codes.values()):
         raise RuntimeError(f"workers exited with codes {codes}")
-    return codes
+    return 0
 
 
 def main():
     import argparse
 
     ap = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    ap.add_argument("--ips", default="127.0.0.1", help="comma-separated host list")
     ap.add_argument("--nproc_per_node", type=int, default=1)
+    ap.add_argument("--coordinator_port", type=int, default=8476)
     ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--elastic", action="store_true")
+    ap.add_argument("--max_restarts", type=int, default=3)
     ap.add_argument("script")
     ap.add_argument("script_args", nargs="...")
     args = ap.parse_args()
-    launch(args.script, args.script_args, nproc_per_node=args.nproc_per_node, log_dir=args.log_dir)
+    launch(
+        args.script, args.script_args, ips=args.ips,
+        nproc_per_node=args.nproc_per_node, coordinator_port=args.coordinator_port,
+        log_dir=args.log_dir, elastic=args.elastic, max_restarts=args.max_restarts,
+    )
 
 
 if __name__ == "__main__":

@@ -23,15 +23,39 @@ def init_parallel_env():
     if _init_done:
         return ParallelEnv()
     coord = os.environ.get("PADDLE_TPU_COORDINATOR") or os.environ.get("COORDINATOR_ADDRESS")
-    if coord and jax.process_count() == 1:
-        try:
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if coord and n > 1:
+        # must run BEFORE any backend touch (jax.process_count() would
+        # initialize the client and make distributed init a no-op)
+        already = False
+        if hasattr(jax.distributed, "is_initialized"):
+            already = jax.distributed.is_initialized()
+        else:  # fallback for older jax without the public probe
+            try:
+                from jax._src import distributed as _jdist
+
+                already = getattr(_jdist.global_state, "coordinator_address", None) is not None
+            except ImportError:
+                pass
+        if not already:
             jax.distributed.initialize(
                 coordinator_address=coord,
-                num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+                num_processes=n,
                 process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
             )
+    # elastic mode: register this worker's heartbeat on the elastic store
+    est = os.environ.get("PADDLE_ELASTIC_STORE")
+    wid = os.environ.get("PADDLE_ELASTIC_WORKER_ID")
+    if est and wid:
+        try:
+            from . import TCPStore
+            from .fleet.elastic import ElasticManager
+
+            host, _, port = est.partition(":")
+            store = TCPStore(host=host, port=int(port), is_master=False)
+            ElasticManager(store, n, worker_id=wid).register()
         except Exception:
-            pass
+            pass  # heartbeat is advisory; training proceeds without it
     _init_done = True
     return ParallelEnv()
 

@@ -53,6 +53,17 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=Tr
             fx = ((gx + 1) * W - 1) / 2
             fy = ((gy + 1) * H - 1) / 2
 
+        if padding_mode == "reflection":
+            # reflect sample coordinates back into the image (reference
+            # grid_sampler reflection padding), then clamp residual drift
+            def refl(c, n):
+                span = jnp.maximum(n - 1, 1)
+                c = jnp.abs(c) % (2 * span)
+                return jnp.where(c > span, 2 * span - c, c)
+
+            fx = refl(fx, W)
+            fy = refl(fy, H)
+
         def gather(feat_n, yy, xx):
             # feat_n: (C,H,W); yy/xx int arrays (Hg,Wg)
             inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
@@ -61,7 +72,7 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=Tr
             v = feat_n[:, yc, xc]  # (C,Hg,Wg)
             if padding_mode == "zeros":
                 v = jnp.where(inb[None], v, 0.0)
-            return v
+            return v  # border/reflection: clamped
 
         def sample_n(feat_n, fx_n, fy_n):
             if mode == "nearest":
@@ -204,18 +215,22 @@ def gather_tree(ids, parents):
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
-    """Reference unpool_op: scatter pooled values back to indices."""
+    """Reference unpool_op: scatter pooled values back to indices.
+    Default output size = (H-1)*stride + kernel - 2*padding (reference
+    formula)."""
     xt, it = as_tensor(x), as_tensor(indices)
     if isinstance(kernel_size, int):
         kernel_size = (kernel_size, kernel_size)
     stride = stride or kernel_size
     if isinstance(stride, int):
         stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
 
-    def fn(v, idx, kh=2, kw=2, sh=2, sw=2, oh=0, ow=0):
+    def fn(v, idx, kh=2, kw=2, sh=2, sw=2, ph=0, pw=0, oh=0, ow=0):
         N, C, H, W = v.shape
-        OH = oh or H * sh
-        OW = ow or W * sw
+        OH = oh or (H - 1) * sh + kh - 2 * ph
+        OW = ow or (W - 1) * sw + kw - 2 * pw
         flat = jnp.zeros((N, C, OH * OW), v.dtype)
         out = flat.at[
             jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None],
@@ -229,7 +244,8 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="N
     return eager_call(
         "max_unpool2d", fn, [xt, it],
         attrs={"kh": kernel_size[0], "kw": kernel_size[1],
-               "sh": stride[0], "sw": stride[1], "oh": oh, "ow": ow},
+               "sh": stride[0], "sw": stride[1],
+               "ph": padding[0], "pw": padding[1], "oh": oh, "ow": ow},
     )
 
 
@@ -240,8 +256,9 @@ def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="N
     ks = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
     st = stride if stride is not None else ks
     st = st if isinstance(st, int) else st[0]
+    pd = padding if isinstance(padding, int) else padding[0]
     osz = None if output_size is None else [1, int(output_size[-1])]
-    out = max_unpool2d(x4, i4, (1, ks), (1, st), output_size=osz)
+    out = max_unpool2d(x4, i4, (1, ks), (1, st), padding=(0, pd), output_size=osz)
     return out.squeeze(-2)
 
 
@@ -253,9 +270,14 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="N
     if isinstance(stride, int):
         stride = (stride,) * 3
 
-    def fn(v, idx, kd=2, kh=2, kw=2, sd=2, sh=2, sw=2, od=0, oh=0, ow=0):
+    if isinstance(padding, int):
+        padding = (padding,) * 3
+
+    def fn(v, idx, kd=2, kh=2, kw=2, sd=2, sh=2, sw=2, pd=0, ph=0, pw=0, od=0, oh=0, ow=0):
         N, C, D, H, W = v.shape
-        OD, OH, OW = od or D * sd, oh or H * sh, ow or W * sw
+        OD = od or (D - 1) * sd + kd - 2 * pd
+        OH = oh or (H - 1) * sh + kh - 2 * ph
+        OW = ow or (W - 1) * sw + kw - 2 * pw
         flat = jnp.zeros((N, C, OD * OH * OW), v.dtype)
         out = flat.at[
             jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None],
@@ -270,6 +292,7 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="N
         "max_unpool3d", fn, [xt, it],
         attrs={"kd": kernel_size[0], "kh": kernel_size[1], "kw": kernel_size[2],
                "sd": stride[0], "sh": stride[1], "sw": stride[2],
+               "pd": padding[0], "ph": padding[1], "pw": padding[2],
                "od": od, "oh": oh, "ow": ow},
     )
 

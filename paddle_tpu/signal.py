@@ -13,19 +13,28 @@ from .core.dispatch import as_tensor, eager_call
 
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
-    """Slice overlapping frames (reference signal.py frame)."""
+    """Slice overlapping frames along ``axis`` (reference signal.py frame):
+    axis=-1 -> (..., frame_length, num_frames); axis=0 -> (num_frames,
+    frame_length, ...)."""
     t = as_tensor(x)
 
-    def fn(a, frame_length=0, hop_length=0):
+    def fn(a, frame_length=0, hop_length=0, axis=-1):
+        if axis not in (-1, a.ndim - 1):
+            a = jnp.moveaxis(a, axis, -1)
         n = a.shape[-1]
         num = 1 + (n - frame_length) // hop_length
         starts = jnp.arange(num) * hop_length
         idx = starts[:, None] + jnp.arange(frame_length)[None, :]
-        return jnp.moveaxis(a[..., idx], -2, -1)  # (..., frame_length, num)
+        out = jnp.moveaxis(a[..., idx], -2, -1)  # (..., frame_length, num)
+        if axis not in (-1, out.ndim - 2):
+            # frame axis expands to (frame_length, num) at its position
+            out = jnp.moveaxis(out, (-2, -1), (axis + 1, axis))
+        return out
 
     return eager_call(
         "signal.frame", fn, [t],
-        attrs={"frame_length": int(frame_length), "hop_length": int(hop_length)},
+        attrs={"frame_length": int(frame_length), "hop_length": int(hop_length),
+               "axis": int(axis)},
     )
 
 
@@ -33,7 +42,9 @@ def overlap_add(x, hop_length, axis=-1, name=None):
     """Inverse of frame (reference signal.py overlap_add)."""
     t = as_tensor(x)
 
-    def fn(a, hop_length=0):
+    def fn(a, hop_length=0, axis=-1):
+        if axis not in (-1, a.ndim - 1):
+            a = jnp.moveaxis(a, (axis, axis + 1), (-1, -2))
         # (..., frame_length, num) -> (..., n)
         fl, num = a.shape[-2], a.shape[-1]
         n = (num - 1) * hop_length + fl
@@ -41,9 +52,15 @@ def overlap_add(x, hop_length, axis=-1, name=None):
         # scatter-add each frame onto the output line
         idx = (jnp.arange(num)[:, None] * hop_length + jnp.arange(fl)[None, :]).reshape(-1)
         out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
-        return out.at[..., idx].add(vals)
+        out = out.at[..., idx].add(vals)
+        if axis not in (-1, out.ndim - 1):
+            out = jnp.moveaxis(out, -1, axis)
+        return out
 
-    return eager_call("signal.overlap_add", fn, [t], attrs={"hop_length": int(hop_length)})
+    return eager_call(
+        "signal.overlap_add", fn, [t],
+        attrs={"hop_length": int(hop_length), "axis": int(axis)},
+    )
 
 
 def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,

@@ -301,7 +301,7 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
         dilation = (dilation, dilation)
     kh, kw = int(wt.shape[-2]), int(wt.shape[-1])
 
-    def fn(feat, off, w, *rest, sh=1, sw=1, ph=0, pw=0, dh=1, dw=1, kh=3, kw=3):
+    def fn(feat, off, w, *rest, sh=1, sw=1, ph=0, pw=0, dh=1, dw=1, kh=3, kw=3, groups=1):
         msk = rest[0] if rest else None
         N, C, H, W = feat.shape
         OC = w.shape[0]
@@ -354,12 +354,21 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
         else:
             zero = jnp.zeros(())  # 0-d sentinel: "no mask"
             cols = jax.vmap(lambda f, o: sample(f, o, zero))(feat_p, off)
-        return jnp.einsum("nckhij,ockh->noij", cols.reshape(N, C, kh, kw, OH, OW), w)
+        cols = cols.reshape(N, C, kh, kw, OH, OW)
+        G = groups
+        if G == 1:
+            return jnp.einsum("nckhij,ockh->noij", cols, w)
+        # grouped conv: contract each channel group with its weight block
+        cols_g = cols.reshape(N, G, C // G, kh, kw, OH, OW)
+        w_g = w.reshape(G, w.shape[0] // G, C // G, kh, kw)
+        out = jnp.einsum("ngckhij,gockh->ngoij", cols_g, w_g)
+        return out.reshape(N, w.shape[0], OH, OW)
 
     out = eager_call(
         "deform_conv2d", fn, args,
         attrs={"sh": stride[0], "sw": stride[1], "ph": padding[0], "pw": padding[1],
-               "dh": dilation[0], "dw": dilation[1], "kh": kh, "kw": kw},
+               "dh": dilation[0], "dw": dilation[1], "kh": kh, "kw": kw,
+               "groups": int(groups)},
     )
     if bias is not None:
         out = out + as_tensor(bias).reshape([1, -1, 1, 1])

@@ -1,0 +1,197 @@
+"""Multi-process bootstrap + elastic tests.
+
+Reference methodology: ``test_dist_base.py:783`` — spawn real worker
+processes on one host, rendezvous, run a collective, compare. Here: 2
+processes, CPU backend (Gloo collectives), our init_parallel_env →
+jax.distributed.initialize path, TCPStore rendezvous, and the elastic
+heartbeat manager.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys, json
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import parallel_env
+
+    env = parallel_env.init_parallel_env()
+    assert env.rank == rank, (env.rank, rank)
+    assert env.world_size == 2, env.world_size
+
+    # TCPStore rendezvous: exchange values through the native KV store
+    from paddle_tpu.distributed import TCPStore
+    store = TCPStore(port=int(os.environ["STORE_PORT"]), is_master=(rank == 0))
+    store.set(f"hello/{rank}", str(rank * 10))
+    n = store.add("barrier", 1)
+    while store.add("barrier", 0) < 2:
+        pass
+    other = store.get(f"hello/{1 - rank}")
+    assert other == str((1 - rank) * 10).encode(), other
+
+    # cross-process collective through the XLA CPU (Gloo) backend
+    import jax, jax.numpy as jnp
+    out = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+        jnp.ones((jax.local_device_count(),)) * (rank + 1)
+    )
+    assert float(out[0]) == 3.0, out
+    print(json.dumps({"rank": rank, "psum": float(out[0])}), flush=True)
+    """
+)
+
+
+def _spawn(rank, port, store_port, extra_env=None):
+    env = {k: v for k, v in os.environ.items() if k not in ("PYTHONPATH", "XLA_FLAGS")}
+    env.update(
+        {
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "STORE_PORT": str(store_port),
+        }
+    )
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-c", WORKER], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+
+class TestMultiProcessBootstrap:
+    def test_two_process_rendezvous_and_collective(self):
+        port, store_port = 9931, 9932
+        p0 = _spawn(0, port, store_port)
+        p1 = _spawn(1, port, store_port)
+        out0, _ = p0.communicate(timeout=180)
+        out1, _ = p1.communicate(timeout=180)
+        assert p0.returncode == 0, out0.decode()[-2000:]
+        assert p1.returncode == 0, out1.decode()[-2000:]
+        r0 = json.loads(out0.decode().strip().splitlines()[-1])
+        assert r0["psum"] == 3.0
+
+
+class TestElastic:
+    def _store(self, port):
+        from paddle_tpu.distributed import TCPStore
+
+        return TCPStore(port=port, is_master=True)
+
+    def test_heartbeat_scale_down_detection(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+        store = self._store(9941)
+        w0 = ElasticManager(store, 2, worker_id="w0", heartbeat_interval=0.2, timeout=1.0).register()
+        w1 = ElasticManager(store, 2, worker_id="w1", heartbeat_interval=0.2, timeout=1.0).register()
+        watcher = ElasticManager(store, 2, heartbeat_interval=0.2, timeout=1.0)
+        ids = ["w0", "w1"]
+        assert watcher.watch(ids) == ElasticStatus.HOLD
+        assert sorted(watcher.alive_workers(ids)) == ids
+        # w1 dies: heartbeats stop, watcher must flag the fault
+        w1.deregister()
+        deadline = time.time() + 5
+        status = None
+        while time.time() < deadline:
+            status = watcher.watch(ids)
+            if status in (ElasticStatus.ERROR, ElasticStatus.RESTART):
+                break
+            time.sleep(0.2)
+        assert status == ElasticStatus.ERROR  # below min_np floor
+        w0.deregister()
+        store.close()
+
+    def test_scale_tolerant_hold_with_min_np(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+        store = self._store(9942)
+        w0 = ElasticManager(store, 2, worker_id="a", heartbeat_interval=0.2, timeout=1.0).register()
+        w1 = ElasticManager(store, 2, worker_id="b", heartbeat_interval=0.2, timeout=1.0).register()
+        watcher = ElasticManager(store, 2, min_np=1, heartbeat_interval=0.2, timeout=1.0)
+        ids = ["a", "b"]
+        assert watcher.watch(ids) == ElasticStatus.HOLD
+        w1.deregister()
+        deadline = time.time() + 5
+        status = None
+        while time.time() < deadline:
+            status = watcher.watch(ids)
+            if status == ElasticStatus.RESTART:
+                break
+            time.sleep(0.2)
+        # min_np=1 permits running with 1 worker -> membership-change RESTART
+        assert status == ElasticStatus.RESTART
+        assert watcher.world() == ["a"]
+        w0.deregister()
+        store.close()
+
+    def test_elastic_launcher_restarts_crashed_worker(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import ElasticLauncher, ElasticManager
+
+        store = self._store(9943)
+        watcher = ElasticManager(store, 1, heartbeat_interval=0.2, timeout=3.0)
+        marker = tmp_path / "attempt"
+
+        def spawn(ids):
+            # crash on first attempt, succeed on second (reference: fault -> relaunch)
+            code = (
+                "import os, sys\n"
+                f"m = {str(marker)!r}\n"
+                "first = not os.path.exists(m)\n"
+                "open(m, 'a').write('x')\n"
+                "sys.exit(1 if first else 0)\n"
+            )
+            env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+            env["PYTHONPATH"] = REPO
+            return {
+                "w0": subprocess.Popen([sys.executable, "-c", code], env=env)
+            }
+
+        launcher = ElasticLauncher(spawn, watcher, watch_interval=0.3, max_restarts=2)
+        rc = launcher.run(["w0"])
+        assert rc == 0
+        assert marker.read_text() == "xx"  # exactly one restart
+        store.close()
+
+
+class TestLauncher:
+    def test_cluster_topology(self):
+        from paddle_tpu.distributed.launch_mod import get_cluster
+
+        c = get_cluster(["10.0.0.1", "10.0.0.2"], 2, 9000)
+        assert c.world_size == 4
+        assert c.pods[1].trainers[0].rank == 2
+        assert c.trainer_endpoints()[0] == "10.0.0.1:9001"
+        assert c.pod_by_addr("10.0.0.2").node_rank == 1
+
+    def test_launch_two_workers_on_node(self, tmp_path):
+        from paddle_tpu.distributed.launch_mod import launch
+
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, pathlib\n"
+            "d = pathlib.Path(os.environ['OUT_DIR'])\n"
+            "(d / ('rank_' + os.environ['PADDLE_TRAINER_ID'])).write_text(\n"
+            "    os.environ['PADDLE_TRAINER_ENDPOINTS'])\n"
+        )
+        os.environ["OUT_DIR"] = str(tmp_path)
+        try:
+            rc = launch(str(script), nproc_per_node=2, coordinator_port=9960,
+                        log_dir=str(tmp_path / "logs"))
+        finally:
+            os.environ.pop("OUT_DIR", None)
+        assert rc == 0
+        eps = (tmp_path / "rank_0").read_text().split(",")
+        assert len(eps) == 2
+        assert (tmp_path / "rank_1").exists()
+        assert (tmp_path / "logs" / "worker.0.log").exists()
