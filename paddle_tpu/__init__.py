@@ -120,6 +120,9 @@ from . import distributed  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import text  # noqa: F401
+from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
+from . import utils  # noqa: F401
 from . import profiler  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
 from . import distribution  # noqa: F401

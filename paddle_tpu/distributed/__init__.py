@@ -53,3 +53,6 @@ def is_initialized():
     from .parallel_env import _initialized
 
     return _initialized()
+from . import checkpoint  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401

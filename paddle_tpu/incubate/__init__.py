@@ -25,3 +25,8 @@ def softmax_mask_fuse_upper_triangle(x, name=None):
         return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
 
     return eager_call("softmax_mask_fuse_upper_triangle", fn, [as_tensor(x)])
+
+from . import asp  # noqa: F401
+from .custom_op import register_custom_op, get_custom_op, registered_custom_ops  # noqa: F401
+from .. import sparse  # noqa: F401 (paddle.incubate.sparse, the v2.3 namespace)
+from ..ops.extra import segment_sum, segment_mean, segment_max, segment_min  # noqa: F401

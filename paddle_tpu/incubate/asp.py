@@ -1,0 +1,111 @@
+"""ASP — 2:4 structured sparsity.
+
+Parity: reference ``python/paddle/fluid/contrib/sparsity/asp.py:286``
+(prune_model / ASPHelper / OptimizerWithSparsityGuarantee) + ``utils.py``
+mask algorithms (mask_1d / mask_2d_greedy / check_mask_1d). TPU-native: the
+n:m mask is computed with a top-k over reshaped groups and re-applied after
+every optimizer step so training stays on the sparse support.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+# id -> (weakref to the param, mask): the weakref guards against id reuse
+# after GC and lets dead entries be dropped
+_MASKS: Dict[int, Tuple["weakref.ref", "jnp.ndarray"]] = {}
+_SUPPORTED = ("Linear",)
+
+
+def compute_mask_nm(arr, n=2, m=4):
+    """Keep the n largest-magnitude entries of every m-group along the last
+    axis (reference sparsity/utils.py get_mask_1d)."""
+    w = jnp.asarray(arr)
+    last = w.shape[-1]
+    if last % m:
+        return jnp.ones_like(w)  # non-divisible tails stay dense (ref behavior)
+    g = w.reshape(-1, m)
+    kth = jnp.sort(jnp.abs(g), axis=-1)[:, m - n]  # n-th largest per group
+    mask = (jnp.abs(g) >= kth[:, None]).astype(w.dtype)
+    # break ties deterministically: cap at n kept per group
+    idx = jnp.argsort(-jnp.abs(g), axis=-1)
+    rank = jnp.zeros_like(g).at[jnp.arange(g.shape[0])[:, None], idx].set(
+        jnp.broadcast_to(jnp.arange(m, dtype=w.dtype), g.shape)
+    )
+    mask = mask * (rank < n)
+    return mask.reshape(w.shape)
+
+
+def check_mask_nm(arr, n=2, m=4) -> bool:
+    """True iff every m-group has at most n nonzeros (reference check_mask_1d)."""
+    w = np.asarray(arr)
+    if w.shape[-1] % m:
+        return True
+    g = (w.reshape(-1, m) != 0).sum(axis=-1)
+    return bool((g <= n).all())
+
+
+def _prunable_params(model, supported_types) -> List[Tensor]:
+    out = []
+    for _, sub in model.named_sublayers():
+        if type(sub).__name__ in supported_types and hasattr(sub, "weight"):
+            out.append(sub.weight)
+    return out
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True, supported_types=_SUPPORTED):
+    """Compute + apply n:m masks on supported layers (reference asp.py:286
+    prune_model). Masks are remembered so decorated optimizers re-apply them."""
+    for p in _prunable_params(model, supported_types):
+        mask = compute_mask_nm(p._data, n, m)
+        _MASKS[id(p)] = (weakref.ref(p), mask)
+        p._set_data(p._data * mask)
+    return model
+
+
+def apply_masks(params):
+    dead = [k for k, (ref, _) in _MASKS.items() if ref() is None]
+    for k in dead:
+        del _MASKS[k]
+    for p in params:
+        entry = _MASKS.get(id(p))
+        if entry is None:
+            continue
+        ref, mask = entry
+        if ref() is not p:  # id recycled onto a different tensor
+            del _MASKS[id(p)]
+            continue
+        p._set_data(p._data * mask.astype(p._data.dtype))
+
+
+class OptimizerWithSparsityGuarantee:
+    """Optimizer decorator (reference asp.py ASPHelper.decorate): after each
+    step, project pruned weights back onto their mask support."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        apply_masks(self._inner._parameter_list or [])
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)
+
+
+__all__ = [
+    "compute_mask_nm", "check_mask_nm", "prune_model", "decorate",
+    "apply_masks", "OptimizerWithSparsityGuarantee",
+]

@@ -1,0 +1,96 @@
+"""Custom op / custom kernel registration.
+
+Parity: reference ``paddle/fluid/framework/custom_operator.cc`` +
+``phi/core/custom_kernel.cc`` + ``python/paddle/utils/cpp_extension`` — the
+plugin path for user-defined ops. TPU-native: a user op is a jnp/Pallas
+function (optionally with a custom vjp); registering it wires it through
+``eager_call`` so it gets autograd/AMP/jit/nan-scan like built-ins, attaches
+to the ``paddle`` namespace and (optionally) as a Tensor method.
+
+    def my_gelu(x):
+        return 0.5 * x * (1 + jnp.tanh(0.79788456 * (x + 0.044715 * x**3)))
+
+    paddle.incubate.register_custom_op("my_gelu", my_gelu)
+    y = paddle.my_gelu(t)          # autograd-ready
+
+    # Pallas kernel with hand-written vjp:
+    paddle.incubate.register_custom_op(
+        "fused_thing", fwd_fn, vjp=(fwd_res_fn, bwd_fn))
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+
+from ..core.dispatch import as_tensor, eager_call
+
+_REGISTRY = {}
+
+
+def register_custom_op(
+    name: str,
+    fn: Callable,
+    vjp: Optional[Tuple[Callable, Callable]] = None,
+    n_inputs: Optional[int] = None,
+    differentiable: bool = True,
+    nondiff_outputs: Sequence[int] = (),
+    tensor_method: bool = False,
+):
+    """Register ``fn(*arrays, **attrs)`` as op ``name``.
+
+    ``vjp``: optional (fwd, bwd) pair per ``jax.custom_vjp`` — fwd returns
+    (out, residuals), bwd(residuals, cotangent) returns input cotangents.
+    Returns the wrapper (also installed as ``paddle.<name>``).
+    """
+    if name in _REGISTRY:
+        raise ValueError(f"custom op {name!r} already registered")
+
+    impl = fn
+    if vjp is not None:
+        fwd, bwd = vjp
+        impl = jax.custom_vjp(fn)
+        impl.defvjp(fwd, bwd)
+
+    import inspect
+
+    param_names = list(inspect.signature(fn).parameters)
+
+    def op(*inputs, **attrs):
+        attrs.pop("name", None)
+        k = n_inputs if n_inputs is not None else len(inputs)
+        tensors = [as_tensor(t) for t in inputs[:k]]
+        # trailing positionals are non-tensor attrs: map them onto fn's
+        # remaining parameter names so fn(*arrays, **attrs) receives them
+        for pname, val in zip(param_names[k:], inputs[k:]):
+            attrs.setdefault(pname, val)
+        return eager_call(
+            f"custom.{name}", impl, tensors, attrs=attrs,
+            differentiable=differentiable, nondiff_outputs=tuple(nondiff_outputs),
+        )
+
+    op.__name__ = name
+    op.__doc__ = f"Custom op {name!r} (reference custom_operator.cc plugin path)."
+    _REGISTRY[name] = op
+
+    import paddle_tpu as _p
+
+    if not hasattr(_p, name):
+        setattr(_p, name, op)
+    if tensor_method:
+        from ..core.tensor import Tensor
+
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, op)
+    return op
+
+
+def get_custom_op(name: str):
+    return _REGISTRY.get(name)
+
+
+def registered_custom_ops():
+    return sorted(_REGISTRY)
+
+
+__all__ = ["register_custom_op", "get_custom_op", "registered_custom_ops"]

@@ -52,6 +52,40 @@ def _make_inplace(base_name):
     return op_
 
 
+def uniform_(self, min=-1.0, max=1.0, seed=0):
+    """In-place uniform refill (reference uniform_random_inplace op)."""
+    import jax
+    from ..core import random as random_state
+
+    if not self.stop_gradient and grad_enabled():
+        raise RuntimeError("uniform_(): in-place on a tensor that requires grad")
+    key = random_state.next_key()
+    self._set_data(jax.random.uniform(key, self._data.shape, self._data.dtype, min, max))
+    return self
+
+
+def normal_(self, mean=0.0, std=1.0):
+    import jax
+    from ..core import random as random_state
+
+    if not self.stop_gradient and grad_enabled():
+        raise RuntimeError("normal_(): in-place on a tensor that requires grad")
+    key = random_state.next_key()
+    self._set_data(jax.random.normal(key, self._data.shape, self._data.dtype) * std + mean)
+    return self
+
+
+def exponential_(self, lam=1.0):
+    import jax
+    from ..core import random as random_state
+
+    if not self.stop_gradient and grad_enabled():
+        raise RuntimeError("exponential_(): in-place on a tensor that requires grad")
+    key = random_state.next_key()
+    self._set_data(jax.random.exponential(key, self._data.shape, self._data.dtype) / lam)
+    return self
+
+
 def fill_(self, value):
     import jax.numpy as jnp
 
@@ -75,12 +109,13 @@ def attach():
         INPLACE_OPS[name] = fn
         if not hasattr(Tensor, name):
             setattr(Tensor, name, fn)
-    INPLACE_OPS["fill_"] = fill_
-    INPLACE_OPS["zero_"] = zero_
-    if not hasattr(Tensor, "fill_"):
-        Tensor.fill_ = fill_
-    if not hasattr(Tensor, "zero_"):
-        Tensor.zero_ = zero_
+    for name, fn in (
+        ("fill_", fill_), ("zero_", zero_), ("uniform_", uniform_),
+        ("normal_", normal_), ("exponential_", exponential_),
+    ):
+        INPLACE_OPS[name] = fn
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
 
 
 attach()

@@ -51,6 +51,23 @@ def all_ops() -> Dict[str, Callable]:
         ops.update({f"vision.{n}": v for n, v in _module_fns(vops).items()})
     except ImportError:
         pass
+    from .. import sparse as sparse_mod
+
+    ops.update({
+        f"sparse.{n}": getattr(sparse_mod, n)
+        for n in sparse_mod.__all__ if callable(getattr(sparse_mod, n))
+    })
+    from .. import quantization as quant_mod
+
+    ops.update({
+        f"quant.{n}": getattr(quant_mod, n)
+        for n in ("fake_quantize_dequantize_abs_max", "quantize_to_int8")
+    })
+    try:
+        from .. import text as text_mod
+        ops.update({f"text.{n}": getattr(text_mod, n) for n in ("viterbi_decode",)})
+    except ImportError:
+        pass
     ops.update(inplace.INPLACE_OPS)
     return ops
 

@@ -1,0 +1,209 @@
+"""Quantization — QAT (fake-quant with STE) and post-training quantization.
+
+Parity: reference ``python/paddle/fluid/contrib/slim/quantization/``
+(imperative/qat.py:41 ImperativeQuantAware — swaps Linear/Conv2D for
+fake-quant wrappers; post_training_quantization.py:125 — calibration-based
+scale search). TPU-native: int8 fake-quant runs INSIDE the jit program
+(AQT-style), so XLA folds the quantize-dequantize pair into the surrounding
+matmul schedule; the straight-through estimator is a ``jax.custom_vjp``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import as_tensor, eager_call
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+# -- fake quant primitives ---------------------------------------------------
+
+@jax.custom_vjp
+def _fake_quant_ste(x, scale):
+    """Quantize-dequantize to int8 grid; gradient passes straight through."""
+    q = jnp.clip(jnp.round(x / scale * 127.0), -127.0, 127.0)
+    return q * scale / 127.0
+
+
+def _fq_fwd(x, scale):
+    return _fake_quant_ste(x, scale), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    # STE inside the clip range, zero outside (reference fake_quantize op grad)
+    mask = (jnp.abs(x) <= scale).astype(g.dtype)
+    return g * mask, jnp.zeros_like(scale)
+
+
+_fake_quant_ste.defvjp(_fq_fwd, _fq_bwd)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8, name=None):
+    """One-shot abs-max fake quant (reference fake_quantize_dequantize ops)."""
+    t = as_tensor(x)
+
+    def fn(a):
+        scale = jnp.maximum(jnp.max(jnp.abs(a)), 1e-8)
+        return _fake_quant_ste(a, scale)
+
+    return eager_call("fake_quant_abs_max", fn, [t])
+
+
+def quantize_to_int8(x):
+    """Real int8 quantization: returns (int8 values, fp scale)."""
+    t = as_tensor(x)
+    arr = t._data
+    scale = float(jnp.maximum(jnp.max(jnp.abs(arr)), 1e-8))
+    q = jnp.clip(jnp.round(arr / scale * 127.0), -127, 127).astype(jnp.int8)
+    return Tensor(q, stop_gradient=True), scale
+
+
+# -- QAT layer wrappers ------------------------------------------------------
+
+class FakeQuantAbsMax(Layer):
+    """Weight quantizer: per-tensor abs-max, recomputed each step."""
+
+    def forward(self, x):
+        def fn(a):
+            scale = jnp.maximum(jnp.max(jnp.abs(a)), 1e-8)
+            return _fake_quant_ste(a, scale)
+
+        return eager_call("fq_weight_abs_max", fn, [as_tensor(x)])
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Activation quantizer with EMA scale (reference
+    MovingAverageAbsMaxScale op; rate 0.9 default)."""
+
+    def __init__(self, rate=0.9):
+        super().__init__()
+        self._rate = rate
+        self.register_buffer("scale", Tensor(jnp.ones(()), stop_gradient=True))
+        self._initialized = False
+
+    def forward(self, x):
+        t = as_tensor(x)
+        if self.training:
+            cur = float(jnp.max(jnp.abs(t._data)))
+            prev = float(np.asarray(self.scale._data))
+            new = cur if not self._initialized else self._rate * prev + (1 - self._rate) * cur
+            self._initialized = True
+            self.scale._set_data(jnp.asarray(new))
+        s = self.scale._data
+
+        def fn(a, s):
+            return _fake_quant_ste(a, jnp.maximum(s, 1e-8).astype(a.dtype))
+
+        return eager_call("fq_act_ema", fn, [t, Tensor(s, stop_gradient=True)])
+
+
+class QuantedLayer(Layer):
+    """Wraps a Linear/Conv2D: fake-quant weight + input, then run the
+    original layer's math with the quantized values (reference
+    imperative/qat.py QuantizedLinear/QuantizedConv2D)."""
+
+    def __init__(self, inner, weight_quantizer=None, act_quantizer=None):
+        super().__init__()
+        # plain attribute assignment auto-registers sublayers (Layer.__setattr__)
+        self.inner = inner
+        self.weight_quantizer = weight_quantizer or FakeQuantAbsMax()
+        self.act_quantizer = act_quantizer or FakeQuantMovingAverageAbsMax()
+
+    def forward(self, x):
+        xq = self.act_quantizer(x)
+        w = self.inner.weight
+        wq = self.weight_quantizer(w)
+        saved = w._data
+        try:
+            w._data = wq._data if isinstance(wq, Tensor) else wq
+            return self.inner(xq)
+        finally:
+            w._data = saved
+
+
+class ImperativeQuantAware:
+    """QAT driver (reference imperative/qat.py:41)."""
+
+    QUANTIZABLE = ("Linear", "Conv2D", "Conv1D")
+
+    def __init__(self, quantizable_layer_type=None, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max", weight_bits=8,
+                 activation_bits=8, moving_rate=0.9, **kw):
+        self.types = tuple(quantizable_layer_type or self.QUANTIZABLE)
+        self.moving_rate = moving_rate
+
+    def quantize(self, model: Layer):
+        """Swap quantizable sublayers for QuantedLayer wrappers, in place."""
+        for name, child in list(model._sub_layers.items()):
+            if type(child).__name__ in self.types and hasattr(child, "weight"):
+                model._sub_layers[name] = QuantedLayer(
+                    child,
+                    FakeQuantAbsMax(),
+                    FakeQuantMovingAverageAbsMax(self.moving_rate),
+                )
+            else:
+                self.quantize(child)
+        return model
+
+
+class PostTrainingQuantization:
+    """PTQ (reference post_training_quantization.py:125): calibrate
+    activation scales over sample batches, quantize weights to int8+scale."""
+
+    def __init__(self, model: Layer, data_loader=None, algo="abs_max",
+                 quantizable_layer_type=None, batch_nums=10, **kw):
+        self.model = model
+        self.data_loader = data_loader
+        self.algo = algo
+        self.types = tuple(quantizable_layer_type or ImperativeQuantAware.QUANTIZABLE)
+        self.batch_nums = batch_nums
+        self.act_scales = {}
+        self.weight_scales = {}
+
+    def _collect(self, layer_name):
+        def hook(layer, inputs, output):
+            arr = output._data if isinstance(output, Tensor) else output
+            cur = float(jnp.max(jnp.abs(arr)))
+            if self.algo == "avg":
+                prev = self.act_scales.get(layer_name)
+                self.act_scales[layer_name] = cur if prev is None else 0.5 * (prev + cur)
+            else:  # abs_max
+                self.act_scales[layer_name] = max(self.act_scales.get(layer_name, 0.0), cur)
+        return hook
+
+    def quantize(self):
+        """Run calibration then fold int8 weights; returns the model with
+        per-layer scales in .act_scales/.weight_scales."""
+        handles = []
+        for name, sub in self.model.named_sublayers():
+            if type(sub).__name__ in self.types and hasattr(sub, "weight"):
+                handles.append(sub.register_forward_post_hook(self._collect(name)))
+        if self.data_loader is not None:
+            self.model.eval()
+            for i, batch in enumerate(self.data_loader):
+                if i >= self.batch_nums:
+                    break
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                self.model(x)
+        for h in handles:
+            h.remove()
+        # weight quantization: int8 + scale, dequantized in place (the AOT
+        # export then folds the q/dq pair; scales kept for int8 serving)
+        for name, sub in self.model.named_sublayers():
+            if type(sub).__name__ in self.types and hasattr(sub, "weight"):
+                q, scale = quantize_to_int8(sub.weight)
+                self.weight_scales[name] = scale
+                sub.weight._set_data(
+                    (q._data.astype(jnp.float32) * scale / 127.0).astype(sub.weight._data.dtype)
+                )
+        return self.model
+
+
+__all__ = [
+    "fake_quantize_dequantize_abs_max", "quantize_to_int8",
+    "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax", "QuantedLayer",
+    "ImperativeQuantAware", "PostTrainingQuantization",
+]

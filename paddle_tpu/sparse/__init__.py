@@ -1,0 +1,287 @@
+"""paddle.sparse — COO/CSR sparse tensors and ops.
+
+Parity: reference ``paddle/phi/core/sparse_coo_tensor.h`` /
+``sparse_csr_tensor.h``, kernels in ``paddle/phi/kernels/sparse/``, Python
+surface ``python/paddle/incubate/sparse`` (v2.3 namespace; also exposed here
+as ``paddle.sparse``). TPU-native substrate: ``jax.experimental.sparse``
+BCOO/BCSR — XLA-native batched sparse formats whose matmuls lower to
+gather/scatter+MXU programs, differentiable end to end.
+
+SelectedRows (``paddle/phi/core/selected_rows.h:27``) is also here: the
+rows+values embedding-gradient format with lazy merge.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.dispatch import as_tensor
+from ..core.tensor import Tensor
+
+
+class SparseTensor(Tensor):
+    """Base for sparse tensors: wraps a jax.experimental.sparse matrix in the
+    Tensor protocol WITHOUT densifying — ``_data`` holds only the stored
+    values; shape metadata reflects the logical dense shape. Dense kernels
+    require an explicit ``.to_dense()`` (same contract as the reference:
+    phi dense kernels reject sparse inputs)."""
+
+    __slots__ = ("_sp",)
+
+    def __init__(self, sp, stop_gradient=True):
+        self._sp = sp
+        super().__init__(sp.data, stop_gradient=stop_gradient)
+
+    @property
+    def shape(self):
+        return list(self._sp.shape)
+
+    @property
+    def ndim(self):
+        return len(self._sp.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._sp.shape))
+
+    @property
+    def is_sparse(self):
+        return True
+
+    def numpy(self):
+        return np.asarray(self._sp.todense())
+
+    def to_dense(self):
+        return Tensor(self._sp.todense(), stop_gradient=self.stop_gradient)
+
+    def nnz(self):
+        return int(self._sp.nse)
+
+    # dense Tensor methods would silently operate on the 1-D values buffer —
+    # block the common ones with a clear error (reference: phi dense kernels
+    # raise on sparse inputs)
+    def _no_dense(self, *a, **k):
+        raise TypeError(
+            "dense op on a sparse tensor: use paddle.sparse.* ops or call "
+            ".to_dense() first"
+        )
+
+    __add__ = __radd__ = __sub__ = __mul__ = __rmul__ = __truediv__ = _no_dense
+    __matmul__ = __neg__ = _no_dense
+    sum = mean = max = min = reshape = transpose = matmul = _no_dense
+
+
+class SparseCooTensor(SparseTensor):
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._sp.indices, 0, 1), stop_gradient=True)
+
+    def values(self):
+        return Tensor(self._sp.data, stop_gradient=self.stop_gradient)
+
+    def coalesce(self):
+        return SparseCooTensor(self._sp.sum_duplicates(), self.stop_gradient)
+
+    def is_sparse_coo(self):
+        return True
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._sp.sum_duplicates()), self.stop_gradient)
+
+
+class SparseCsrTensor(SparseTensor):
+    def crows(self):
+        return Tensor(self._sp.indptr, stop_gradient=True)
+
+    def cols(self):
+        return Tensor(self._sp.indices, stop_gradient=True)
+
+    def values(self):
+        return Tensor(self._sp.data, stop_gradient=self.stop_gradient)
+
+    def is_sparse_csr(self):
+        return True
+
+    def to_sparse_coo(self, sparse_dim=2):
+        return SparseCooTensor(self._sp.to_bcoo(), self.stop_gradient)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    """Build a COO tensor (reference sparse_coo_tensor API: indices (ndim, nnz))."""
+    idx = np.asarray(as_tensor(indices)._data, np.int32)
+    vals = as_tensor(values)._data
+    if dtype is not None:
+        from ..core import dtype as dtypes
+
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    sp = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(sp, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    vals = as_tensor(values)._data
+    if dtype is not None:
+        from ..core import dtype as dtypes
+
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    sp = jsparse.BCSR(
+        (vals, jnp.asarray(as_tensor(cols)._data, jnp.int32),
+         jnp.asarray(as_tensor(crows)._data, jnp.int32)),
+        shape=tuple(int(s) for s in shape),
+    )
+    return SparseCsrTensor(sp, stop_gradient=stop_gradient)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    t = as_tensor(x)
+    return SparseCooTensor(jsparse.BCOO.fromdense(t._data), stop_gradient=t.stop_gradient)
+
+
+def to_sparse_csr(x):
+    t = as_tensor(x)
+    return SparseCsrTensor(jsparse.BCSR.fromdense(t._data), stop_gradient=t.stop_gradient)
+
+
+def _sp(x):
+    if isinstance(x, SparseTensor):
+        return x._sp
+    raise TypeError(f"expected a sparse tensor, got {type(x).__name__}")
+
+
+def _rewrap(sp, like):
+    cls = SparseCsrTensor if isinstance(sp, jsparse.BCSR) else SparseCooTensor
+    return cls(sp, stop_gradient=like.stop_gradient)
+
+
+# -- sparse ops (reference phi/kernels/sparse/) ------------------------------
+
+def add(x, y, name=None):
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        a = _sp(x)
+        b = _sp(y)
+        if isinstance(a, jsparse.BCSR):
+            a = a.to_bcoo()
+        if isinstance(b, jsparse.BCSR):
+            b = b.to_bcoo()
+        out = jsparse.BCOO(
+            (jnp.concatenate([a.data, b.data]), jnp.concatenate([a.indices, b.indices])),
+            shape=a.shape,
+        ).sum_duplicates()
+        return _rewrap(out, x)
+    # mixed sparse/dense: densify the sparse side
+    xd = x.to_dense() if isinstance(x, SparseTensor) else as_tensor(x)
+    yd = y.to_dense() if isinstance(y, SparseTensor) else as_tensor(y)
+    return Tensor(xd._data + yd._data, stop_gradient=xd.stop_gradient and yd.stop_gradient)
+
+
+def multiply(x, y, name=None):
+    """Elementwise multiply; the result keeps x's sparsity pattern (zero
+    entries stay zero, so gathering y at x's coordinates is exact even when
+    y is itself sparse)."""
+    if not isinstance(x, SparseTensor):
+        raise TypeError("sparse.multiply expects a sparse first operand")
+    sp = _sp(x)
+    coo = sp.to_bcoo() if isinstance(sp, jsparse.BCSR) else sp
+    if isinstance(y, SparseTensor):
+        yv = y._sp.todense()
+    else:
+        yv = as_tensor(y)._data
+    if hasattr(yv, "ndim") and yv.ndim:
+        gathered = yv[tuple(coo.indices[:, i] for i in range(coo.indices.shape[1]))]
+    else:
+        gathered = yv
+    return _rewrap(jsparse.BCOO((coo.data * gathered, coo.indices), shape=coo.shape), x)
+
+
+def matmul(x, y, name=None):
+    """Sparse @ dense -> dense (reference sparse matmul kernel). Lowers to an
+    XLA gather/scatter program; differentiable wrt the dense operand and the
+    sparse values."""
+    sp = _sp(x)
+    yt = as_tensor(y)
+    out = sp @ yt._data
+    res = Tensor(out, stop_gradient=x.stop_gradient and yt.stop_gradient)
+    return res
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) * sparse_mask -> sparse (reference masked_matmul):
+    only mask's nonzero positions are computed/kept."""
+    xt, yt = as_tensor(x), as_tensor(y)
+    m = _sp(mask)
+    coo = m.to_bcoo() if isinstance(m, jsparse.BCSR) else m
+    rows = coo.indices[:, 0]
+    cols = coo.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xt._data[rows], jnp.swapaxes(yt._data, 0, 1)[cols])
+    return _rewrap(jsparse.BCOO((vals, coo.indices), shape=coo.shape), mask)
+
+
+def _unary(fn_name, jfn):
+    def op(x, name=None):
+        sp = _sp(x)
+        coo = sp.to_bcoo() if isinstance(sp, jsparse.BCSR) else sp
+        return _rewrap(jsparse.BCOO((jfn(coo.data), coo.indices), shape=coo.shape), x)
+
+    op.__name__ = fn_name
+    op.__doc__ = f"Sparse elementwise {fn_name} on stored values (phi/kernels/sparse)."
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+abs = _unary("abs", jnp.abs)
+pow = lambda x, factor, name=None: _unary("pow", lambda v: jnp.power(v, factor))(x)  # noqa: E731
+neg = _unary("neg", jnp.negative)
+cast = lambda x, index_dtype=None, value_dtype=None, name=None: _unary(  # noqa: E731
+    "cast", lambda v: v.astype(value_dtype or v.dtype)
+)(x)
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise sparse softmax over stored values (reference
+    sparse/softmax_kernel): missing entries are -inf, so normalization is
+    over each row's nonzeros only."""
+    sp = _sp(x)
+    coo = sp.to_bcoo() if isinstance(sp, jsparse.BCSR) else sp
+    rows = coo.indices[:, 0]
+    n_rows = coo.shape[0]
+    row_max = jnp.full((n_rows,), -jnp.inf, coo.data.dtype).at[rows].max(coo.data)
+    ex = jnp.exp(coo.data - row_max[rows])
+    row_sum = jnp.zeros((n_rows,), coo.data.dtype).at[rows].add(ex)
+    return _rewrap(jsparse.BCOO((ex / row_sum[rows], coo.indices), shape=coo.shape), x)
+
+
+class SelectedRows:
+    """Embedding-gradient format (reference phi/core/selected_rows.h:27):
+    ``rows[i]`` is the embedding row id of ``value[i]``; duplicates allowed
+    until ``merge()`` (reference merge_selected_rows op)."""
+
+    def __init__(self, rows, value, height):
+        self.rows = jnp.asarray(as_tensor(rows)._data, jnp.int32)
+        self.value = as_tensor(value)._data
+        self.height = int(height)
+
+    def merge(self):
+        """Sum duplicate rows (merge_selected_rows)."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True, size=self.rows.shape[0], fill_value=-1)
+        merged = jnp.zeros((uniq.shape[0],) + self.value.shape[1:], self.value.dtype)
+        merged = merged.at[inv].add(self.value)
+        keep = uniq >= 0
+        return SelectedRows(uniq[keep], merged[keep], self.height)
+
+    def to_dense(self):
+        out = jnp.zeros((self.height,) + self.value.shape[1:], self.value.dtype)
+        return Tensor(out.at[self.rows].add(self.value), stop_gradient=True)
+
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "SelectedRows",
+    "sparse_coo_tensor", "sparse_csr_tensor", "to_sparse_coo", "to_sparse_csr",
+    "add", "multiply", "matmul", "masked_matmul", "softmax",
+    "relu", "sin", "tanh", "sqrt", "abs", "pow", "neg", "cast",
+]

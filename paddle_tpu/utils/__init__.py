@@ -1,0 +1,15 @@
+"""paddle.utils — dlpack interop, cpp_extension stand-in, misc helpers.
+
+Parity: reference ``python/paddle/utils/`` (dlpack.py over
+``framework/dlpack_tensor.cc``; cpp_extension builds C++ custom ops).
+"""
+from __future__ import annotations
+
+from . import dlpack  # noqa: F401
+
+try:  # optional alias: unique_name lives in framework in the reference
+    from ..framework import flags as _flags  # noqa: F401
+except ImportError:
+    pass
+
+__all__ = ["dlpack"]

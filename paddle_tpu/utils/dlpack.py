@@ -1,0 +1,53 @@
+"""DLPack interop.
+
+Parity: reference ``python/paddle/utils/dlpack.py`` over
+``paddle/fluid/framework/dlpack_tensor.cc``. Zero-copy where the platform
+supports the DLPack protocol (CPU/GPU); on TPU the buffer is not exportable
+(PJRT restriction), so a host copy is made — semantics preserved, zero-copy
+is not.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import as_tensor
+from ..core.tensor import Tensor
+
+
+class _HostDLPackWrapper:
+    """Carries a host copy that supports __dlpack__ (fallback path)."""
+
+    def __init__(self, arr: np.ndarray):
+        self._arr = np.ascontiguousarray(arr)
+
+    def __dlpack__(self, stream=None):
+        return self._arr.__dlpack__()
+
+    def __dlpack_device__(self):
+        return self._arr.__dlpack_device__()
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack-capable capsule holder (consume with
+    torch.from_dlpack / np.from_dlpack / jnp.from_dlpack)."""
+    t = as_tensor(x)
+    arr = t._data
+    try:
+        arr.__dlpack_device__()
+        return arr  # jax.Array implements the DLPack protocol directly
+    except Exception:
+        return _HostDLPackWrapper(np.asarray(arr))
+
+
+def from_dlpack(dlpack):
+    """DLPack capsule / protocol object -> Tensor."""
+    try:
+        arr = jnp.from_dlpack(dlpack)
+    except Exception:
+        arr = jnp.asarray(np.from_dlpack(dlpack))
+    return Tensor(arr, stop_gradient=True)
+
+
+__all__ = ["to_dlpack", "from_dlpack"]

@@ -1,0 +1,248 @@
+"""Component tests: sparse tensors, SelectedRows, quantization (QAT/PTQ),
+custom-op plugin, DLPack, ASP 2:4, sharded checkpoint + auto-resume,
+auto-parallel completion + XLA cost model.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestSparse:
+    def test_coo_roundtrip_and_ops(self):
+        dense = np.array([[0, 1.0, 0], [2.0, 0, 3.0]], np.float32)
+        sp = paddle.sparse.to_sparse_coo(paddle.to_tensor(dense))
+        assert sp.is_sparse and sp.nnz() == 3
+        assert sp.shape == [2, 3]
+        np.testing.assert_allclose(sp.to_dense().numpy(), dense)
+        r = paddle.sparse.relu(paddle.sparse.to_sparse_coo(paddle.to_tensor(-dense)))
+        np.testing.assert_allclose(r.to_dense().numpy(), np.maximum(-dense, 0))
+
+    def test_coo_construction_and_csr(self):
+        idx = np.array([[0, 1, 1], [2, 0, 2]])
+        vals = np.array([4.0, 5.0, 6.0], np.float32)
+        sp = paddle.sparse.sparse_coo_tensor(idx, vals, shape=[2, 3])
+        dense = np.zeros((2, 3), np.float32)
+        dense[idx[0], idx[1]] = vals
+        np.testing.assert_allclose(sp.to_dense().numpy(), dense)
+        csr = sp.to_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+    def test_sparse_matmul_and_add(self):
+        a = np.array([[0, 2.0], [3.0, 0]], np.float32)
+        d = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        sp = paddle.sparse.to_sparse_coo(paddle.to_tensor(a))
+        out = paddle.sparse.matmul(sp, paddle.to_tensor(d))
+        np.testing.assert_allclose(out.numpy(), a @ d, rtol=1e-5)
+        s2 = paddle.sparse.add(sp, sp)
+        np.testing.assert_allclose(s2.to_dense().numpy(), 2 * a)
+
+    def test_sparse_softmax(self):
+        a = np.array([[1.0, 0, 2.0], [0, 3.0, 0]], np.float32)
+        sp = paddle.sparse.to_sparse_coo(paddle.to_tensor(a))
+        sm = paddle.sparse.softmax(sp).to_dense().numpy()
+        # row 0: softmax over {1, 2} at their positions
+        e = np.exp(np.array([1.0, 2.0]) - 2.0)
+        np.testing.assert_allclose(sm[0, [0, 2]], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(sm[1, 1], 1.0, rtol=1e-6)
+
+    def test_selected_rows_merge(self):
+        sr = paddle.sparse.SelectedRows(
+            rows=np.array([1, 3, 1]), value=np.ones((3, 4), np.float32), height=5
+        )
+        merged = sr.merge()
+        dense = merged.to_dense().numpy()
+        np.testing.assert_allclose(dense[1], 2 * np.ones(4))
+        np.testing.assert_allclose(dense[3], np.ones(4))
+        assert dense[0].sum() == 0
+
+
+class TestQuantization:
+    def test_fake_quant_ste_grad(self):
+        x = paddle.to_tensor(np.array([0.5, -0.25, 0.9], np.float32), stop_gradient=False)
+        y = paddle.quantization.fake_quantize_dequantize_abs_max(x)
+        # quantized values lie on the int8 grid scaled by max|x|
+        scale = 0.9
+        np.testing.assert_allclose(
+            y.numpy(), np.round(x.numpy() / scale * 127) * scale / 127, rtol=1e-5
+        )
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(3), rtol=1e-6)  # STE
+
+    def test_qat_wraps_and_trains(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        paddle.quantization.ImperativeQuantAware().quantize(model)
+        from paddle_tpu.quantization import QuantedLayer
+
+        assert isinstance(model[0], QuantedLayer)
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(16, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.rand(16, 4).astype(np.float32))
+        losses = []
+        for _ in range(12):
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_ptq_calibrates_and_quantizes(self):
+        paddle.seed(1)
+        model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        rng = np.random.RandomState(1)
+        data = [(paddle.to_tensor(rng.rand(4, 4).astype(np.float32)),) for _ in range(4)]
+        w_before = model[0].weight.numpy().copy()
+        ptq = paddle.quantization.PostTrainingQuantization(model, data_loader=data, batch_nums=4)
+        ptq.quantize()
+        assert ptq.act_scales and ptq.weight_scales
+        w_after = model[0].weight.numpy()
+        # weights now on the int8 grid: 255 distinct levels max
+        assert len(np.unique(w_after)) <= 255
+        assert np.abs(w_after - w_before).max() < np.abs(w_before).max() / 32
+
+
+class TestCustomOp:
+    def test_register_and_grad(self):
+        import jax.numpy as jnp
+        from paddle_tpu.incubate import register_custom_op
+
+        op = register_custom_op("my_softsign", lambda x: x / (1 + jnp.abs(x)))
+        x = paddle.to_tensor(np.array([1.0, -2.0], np.float32), stop_gradient=False)
+        y = paddle.my_softsign(x)
+        np.testing.assert_allclose(y.numpy(), x.numpy() / (1 + np.abs(x.numpy())), rtol=1e-6)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 1 / (1 + np.abs(x.numpy())) ** 2, rtol=1e-5)
+
+    def test_custom_vjp(self):
+        import jax.numpy as jnp
+        from paddle_tpu.incubate import register_custom_op
+
+        def f(x):
+            return jnp.square(x)
+
+        def fwd(x):
+            return jnp.square(x), x
+
+        def bwd(res, g):
+            return (g * 100.0,)  # deliberately wrong grad proves the vjp is used
+
+        register_custom_op("sq_weird", f, vjp=(fwd, bwd))
+        x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        paddle.sq_weird(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [100.0])
+
+
+class TestDLPack:
+    def test_torch_roundtrip(self):
+        import torch
+
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        cap = paddle.utils.dlpack.to_dlpack(x)
+        t = torch.from_dlpack(cap)
+        assert t.shape == (2, 3)
+        back = paddle.utils.dlpack.from_dlpack(torch.arange(4).float())
+        np.testing.assert_allclose(back.numpy(), [0, 1, 2, 3])
+
+
+class TestASP:
+    def test_prune_and_guarantee(self):
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(3)
+        model = nn.Sequential(nn.Linear(16, 8))
+        asp.prune_model(model, n=2, m=4)
+        w = model[0].weight.numpy()
+        assert asp.check_mask_nm(w, 2, 4)
+        assert (w == 0).mean() >= 0.5 - 1e-6
+        opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters()))
+        x = paddle.to_tensor(np.random.rand(4, 16).astype(np.float32))
+        y = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        # sparsity survives the update
+        assert asp.check_mask_nm(model[0].weight.numpy(), 2, 4)
+
+
+class TestShardedCheckpoint:
+    def test_sharded_save_restore(self, tmp_path):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+
+        mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("x",))
+        arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+        t = paddle.to_tensor(arr)
+        import jax.numpy as jnp
+
+        t._set_data(jax.device_put(t._data, NamedSharding(mesh, P("x", None))))
+        state = {"w": t, "nested": {"b": paddle.to_tensor(np.ones(3, np.float32))}}
+        save_state_dict(state, str(tmp_path / "ck"))
+        # wipe and restore: sharding must be re-applied
+        t._set_data(jax.device_put(jnp.zeros((8, 8), jnp.float32), NamedSharding(mesh, P("x", None))))
+        load_state_dict(state, str(tmp_path / "ck"))
+        np.testing.assert_allclose(np.asarray(t._data), arr)
+        assert t._data.sharding.spec == P("x", None)
+
+    def test_auto_checkpoint_resume(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+
+        w = paddle.to_tensor(np.zeros(4, np.float32))
+        ac = AutoCheckpoint(str(tmp_path / "auto"), interval_steps=2, keep_last=2)
+        for step in range(6):
+            w._set_data(w._data + 1)
+            ac.maybe_save(step, {"w": w})
+        ac.wait()
+        # fresh state resumes from the last saved step (4: steps 0,2,4 saved)
+        w2 = paddle.to_tensor(np.zeros(4, np.float32))
+        step = ac.resume({"w": w2})
+        assert step == 4
+        np.testing.assert_allclose(w2.numpy(), np.full(4, 5.0))  # after step 4's update
+
+
+class TestAutoParallel:
+    def test_completion_assigns_megatron_specs(self):
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        from paddle_tpu.distributed.auto_parallel import complete_annotations
+
+        mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "mp"))
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 16))
+        complete_annotations(model, mesh)
+        specs = [p.pspec for p in model.parameters() if p.ndim == 2]
+        assert specs[0] is not None and specs[1] is not None
+        assert specs[0] != specs[1]  # column then row (Megatron alternation)
+
+    def test_engine_fit_and_cost(self):
+        import jax
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.auto_parallel import Engine, estimate_cost
+
+        mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "mp"))
+        paddle.seed(1)
+        model = nn.Sequential(nn.Linear(16, 64), nn.Tanh(), nn.Linear(64, 16))
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=model.parameters())
+        mse = lambda pred, y: ((pred - y) ** 2).mean()
+        eng = Engine(model, loss=mse, optimizer=opt, mesh=mesh).prepare()
+        rng = np.random.RandomState(0)
+        data = [
+            (paddle.to_tensor(rng.rand(8, 16).astype(np.float32)),
+             paddle.to_tensor(rng.rand(8, 16).astype(np.float32)))
+            for _ in range(6)
+        ]
+        hist = eng.fit(data, epochs=2)
+        assert hist[-1] < hist[0]
+
+        import jax.numpy as jnp
+
+        cost = estimate_cost(lambda a, b: a @ b, jnp.ones((64, 64)), jnp.ones((64, 64)))
+        assert cost["flops"] >= 2 * 64 * 64 * 64 * 0.9
