@@ -125,6 +125,9 @@ from . import quantization  # noqa: F401
 from . import utils  # noqa: F401
 from . import profiler  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
+from .hapi.flops import flops  # noqa: F401
+from . import onnx  # noqa: F401
+from . import hub  # noqa: F401
 from . import distribution  # noqa: F401
 
 from .io import DataLoader  # noqa: F401

@@ -122,11 +122,20 @@ class Engine:
         return history
 
     def cost(self, *example_batch):
-        """Estimated cost of one training step under the current plan."""
+        """Estimated cost of one training step under the current plan.
+        Read-only: the global RNG stream is restored (same discipline as
+        HybridParallelEngine.lower_text) so the query can't perturb training."""
+        from ...core import random as random_state
+
         if self._engine is None:
             self.prepare()
-        args = self._engine._prepare(*example_batch)
-        compiled = self._engine._jit.lower(*args).compile()
+        st = random_state._get()
+        saved_key = st.key
+        try:
+            args = self._engine._prepare(*example_batch)
+            compiled = self._engine._jit.lower(*args).compile()
+        finally:
+            st.key = saved_key
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}

@@ -76,8 +76,11 @@ def save_state_dict(state_dict: Dict[str, Any], path: str, async_save: bool = Fa
         if old and not os.path.exists(path):
             os.rename(old, path)
         raise
-    if old:
+    if old and not async_save:
         shutil.rmtree(old, ignore_errors=True)
+    # async: the .old backup is kept until the NEXT save parks it away — the
+    # background write may still fail/crash before commit, and the backup is
+    # the only good copy until then
     if async_save:
         return ck  # caller may ck.wait_until_finished()
     # StandardCheckpointer finalizes (atomic rename) in the background even
@@ -168,7 +171,9 @@ class AutoCheckpoint:
 
 def engine_state_dict(engine) -> Dict[str, Any]:
     """Checkpointable view of a HybridParallelEngine: params + opt accums,
-    all kept in their sharded placements."""
+    all kept in their sharded placements. For SAVING; to restore use
+    ``engine_load_state_dict`` (the accum entries here are wrappers around
+    copies — writing into them alone would not reach the optimizer)."""
     state = {}
     for i, p in enumerate(engine.params):
         state[f"param_{i}"] = p
@@ -179,4 +184,23 @@ def engine_state_dict(engine) -> Dict[str, Any]:
     return state
 
 
-__all__ = ["save_state_dict", "load_state_dict", "AutoCheckpoint", "engine_state_dict"]
+def engine_load_state_dict(engine, path) -> None:
+    """Restore params AND optimizer accumulators of a HybridParallelEngine
+    from a checkpoint written via ``engine_state_dict``."""
+    state = engine_state_dict(engine)
+    load_state_dict(state, path)
+    opt = engine.optimizer
+    for i, p in enumerate(engine.params):
+        accum = opt._accumulators.get(id(p))
+        if accum is None:
+            continue
+        for k in list(accum):
+            t = state.get(f"accum_{i}_{k}")
+            if t is not None:
+                accum[k] = t._data
+
+
+__all__ = [
+    "save_state_dict", "load_state_dict", "AutoCheckpoint",
+    "engine_state_dict", "engine_load_state_dict",
+]

@@ -97,6 +97,11 @@ class OptimizerWithSparsityGuarantee:
         self._inner.step()
         apply_masks(self._inner._parameter_list or [])
 
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()  # mask re-projection included
+        return None, None
+
     def clear_grad(self, *a, **k):
         self._inner.clear_grad(*a, **k)
 

@@ -1,0 +1,22 @@
+"""paddle.onnx — export gate.
+
+Parity target: reference ``python/paddle/onnx/export.py`` (paddle2onnx).
+This build's portable AOT format is StableHLO via ``paddle.jit.save`` (runs
+anywhere XLA runs, incl. CPU serving — see paddle_tpu.inference). ONNX
+emission from StableHLO requires an external converter that is not part of
+this environment, so export() raises with that guidance rather than writing
+a file that silently isn't ONNX.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is not available in this build. Use paddle.jit.save() "
+        "to produce a portable StableHLO artifact (loadable on CPU/TPU via "
+        "paddle_tpu.inference.Predictor), or convert that artifact with an "
+        "external StableHLO->ONNX tool."
+    )
+
+
+__all__ = ["export"]

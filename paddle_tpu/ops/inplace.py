@@ -53,13 +53,14 @@ def _make_inplace(base_name):
 
 
 def uniform_(self, min=-1.0, max=1.0, seed=0):
-    """In-place uniform refill (reference uniform_random_inplace op)."""
+    """In-place uniform refill (reference uniform_random_inplace op).
+    seed!=0 makes the refill deterministic, matching reference semantics."""
     import jax
     from ..core import random as random_state
 
     if not self.stop_gradient and grad_enabled():
         raise RuntimeError("uniform_(): in-place on a tensor that requires grad")
-    key = random_state.next_key()
+    key = jax.random.PRNGKey(seed) if seed else random_state.next_key()
     self._set_data(jax.random.uniform(key, self._data.shape, self._data.dtype, min, max))
     return self
 

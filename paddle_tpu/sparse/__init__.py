@@ -162,10 +162,14 @@ def add(x, y, name=None):
     if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
         a = _sp(x)
         b = _sp(y)
+        if tuple(a.shape) != tuple(b.shape):
+            raise ValueError(f"sparse.add shape mismatch: {a.shape} vs {b.shape}")
         if isinstance(a, jsparse.BCSR):
             a = a.to_bcoo()
         if isinstance(b, jsparse.BCSR):
             b = b.to_bcoo()
+        if a.data.dtype != b.data.dtype:
+            b = jsparse.BCOO((b.data.astype(a.data.dtype), b.indices), shape=b.shape)
         out = jsparse.BCOO(
             (jnp.concatenate([a.data, b.data]), jnp.concatenate([a.indices, b.indices])),
             shape=a.shape,
@@ -243,13 +247,23 @@ cast = lambda x, index_dtype=None, value_dtype=None, name=None: _unary(  # noqa:
 
 
 def softmax(x, axis=-1, name=None):
-    """Row-wise sparse softmax over stored values (reference
-    sparse/softmax_kernel): missing entries are -inf, so normalization is
-    over each row's nonzeros only."""
+    """Sparse softmax over the LAST axis (reference sparse/softmax_kernel
+    supports the same): entries sharing all other coordinates form one
+    normalization group; missing entries are -inf so normalization is over
+    nonzeros only."""
     sp = _sp(x)
     coo = sp.to_bcoo() if isinstance(sp, jsparse.BCSR) else sp
-    rows = coo.indices[:, 0]
-    n_rows = coo.shape[0]
+    ndim = len(coo.shape)
+    if axis not in (-1, ndim - 1):
+        raise NotImplementedError("sparse.softmax supports the last axis only")
+    # group id = joint index over all dims except the softmax axis
+    if ndim == 2:
+        rows = coo.indices[:, 0]
+        n_rows = coo.shape[0]
+    else:
+        lead = tuple(coo.indices[:, i] for i in range(ndim - 1))
+        rows = jnp.ravel_multi_index(lead, coo.shape[:-1], mode="clip")
+        n_rows = int(np.prod(coo.shape[:-1]))
     row_max = jnp.full((n_rows,), -jnp.inf, coo.data.dtype).at[rows].max(coo.data)
     ex = jnp.exp(coo.data - row_max[rows])
     row_sum = jnp.zeros((n_rows,), coo.data.dtype).at[rows].add(ex)

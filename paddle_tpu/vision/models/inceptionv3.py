@@ -1,0 +1,139 @@
+"""InceptionV3 (reference python/paddle/vision/models/inceptionv3.py) —
+compact faithful block structure."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class ConvBN(nn.Sequential):
+    def __init__(self, cin, cout, k, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(cin, cout, k, stride=stride, padding=padding, bias_attr=False),
+            nn.BatchNorm2D(cout),
+            nn.ReLU(),
+        )
+
+
+def _cat(xs):
+    import paddle_tpu as paddle
+
+    return paddle.concat(xs, axis=1)
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = ConvBN(cin, 64, 1)
+        self.b5 = nn.Sequential(ConvBN(cin, 48, 1), ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(
+            ConvBN(cin, 64, 1), ConvBN(64, 96, 3, padding=1), ConvBN(96, 96, 3, padding=1)
+        )
+        self.pool = nn.Sequential(nn.AvgPool2D(3, 1, 1), ConvBN(cin, pool_features, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b5(x), self.b3(x), self.pool(x)])
+
+
+class InceptionB(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = ConvBN(cin, 384, 3, stride=2)
+        self.b3d = nn.Sequential(
+            ConvBN(cin, 64, 1), ConvBN(64, 96, 3, padding=1), ConvBN(96, 96, 3, stride=2)
+        )
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b3d(x), self.pool(x)])
+
+
+class InceptionC(nn.Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = ConvBN(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            ConvBN(cin, c7, 1),
+            ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, 192, (7, 1), padding=(3, 0)),
+        )
+        self.b7d = nn.Sequential(
+            ConvBN(cin, c7, 1),
+            ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, 192, (1, 7), padding=(0, 3)),
+        )
+        self.pool = nn.Sequential(nn.AvgPool2D(3, 1, 1), ConvBN(cin, 192, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b7(x), self.b7d(x), self.pool(x)])
+
+
+class InceptionD(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(ConvBN(cin, 192, 1), ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            ConvBN(cin, 192, 1),
+            ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            ConvBN(192, 192, 3, stride=2),
+        )
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b7(x), self.pool(x)])
+
+
+class InceptionE(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = ConvBN(cin, 320, 1)
+        self.b3_1 = ConvBN(cin, 384, 1)
+        self.b3_2a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bd_1 = nn.Sequential(ConvBN(cin, 448, 1), ConvBN(448, 384, 3, padding=1))
+        self.bd_2a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.bd_2b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, 1, 1), ConvBN(cin, 192, 1))
+
+    def forward(self, x):
+        a = self.b3_1(x)
+        d = self.bd_1(x)
+        return _cat([
+            self.b1(x),
+            _cat([self.b3_2a(a), self.b3_2b(a)]),
+            _cat([self.bd_2a(d), self.bd_2b(d)]),
+            self.pool(x),
+        ])
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            ConvBN(3, 32, 3, stride=2), ConvBN(32, 32, 3), ConvBN(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, 2), ConvBN(64, 80, 1), ConvBN(80, 192, 3), nn.MaxPool2D(3, 2),
+        )
+        self.blocks = nn.Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160), InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048),
+        )
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.dropout = nn.Dropout(0.5)
+        self.fc = nn.Linear(2048, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(self.dropout(x.flatten(start_axis=1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)

@@ -122,3 +122,49 @@ class TestVisionModels:
         m(x).sum().backward()
         for p in m.parameters():
             assert p.grad is not None
+
+
+class TestNewVisionFamilies:
+    """The six families added for reference parity (vision/models/): forward
+    shape + backward gradient flow on small inputs."""
+
+    def _check(self, ctor, size=64):
+        paddle.seed(0)
+        m = ctor(num_classes=7)
+        m.train()
+        x = paddle.to_tensor(np.random.rand(1, 3, size, size).astype(np.float32))
+        out = m(x)
+        assert list(out.shape) == [1, 7]
+        out.sum().backward()
+        grads = [p.grad is not None for p in m.parameters() if not p.stop_gradient]
+        assert all(grads)
+
+    def test_squeezenet(self):
+        from paddle_tpu.vision.models import squeezenet1_1
+
+        self._check(squeezenet1_1)
+
+    def test_densenet(self):
+        from paddle_tpu.vision.models import densenet121
+
+        self._check(densenet121)
+
+    def test_mobilenet_v1(self):
+        from paddle_tpu.vision.models import mobilenet_v1
+
+        self._check(mobilenet_v1)
+
+    def test_shufflenet(self):
+        from paddle_tpu.vision.models import shufflenet_v2_x0_25
+
+        self._check(shufflenet_v2_x0_25)
+
+    def test_resnext(self):
+        from paddle_tpu.vision.models import resnext50_32x4d
+
+        self._check(resnext50_32x4d)
+
+    def test_inception(self):
+        from paddle_tpu.vision.models import inception_v3
+
+        self._check(inception_v3, size=128)
