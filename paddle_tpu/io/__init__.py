@@ -442,3 +442,12 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+def data_home():
+    """Dataset cache root (reference paddle.dataset.common.DATA_HOME)."""
+    import os
+
+    return os.environ.get(
+        "PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu/datasets")
+    )

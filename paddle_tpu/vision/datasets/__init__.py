@@ -15,7 +15,9 @@ import numpy as np
 from ...io import Dataset
 
 
-_DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu/datasets"))
+from ...io import data_home
+
+_DATA_HOME = data_home()
 
 
 class MNIST(Dataset):

@@ -242,3 +242,21 @@ class TestMisc:
             paddle.to_tensor(np.array([0, 0, 1])),
         )
         np.testing.assert_allclose(seg.numpy(), [[2.0, 3.0], [5.0, 6.0]])
+
+
+class TestTextDatasets:
+    def test_uci_housing_trains(self):
+        ds = paddle.text.UCIHousing(mode="train")
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert len(paddle.text.UCIHousing(mode="test")) > 0
+
+    def test_imdb_and_friends(self):
+        imdb = paddle.text.Imdb(mode="train")
+        doc, lab = imdb[0]
+        assert doc.dtype == np.int64 and lab in (0, 1)
+        assert len(paddle.text.Imikolov()[0]) == 5
+        words, pred, labels = paddle.text.Conll05st()[0]
+        assert words.shape == pred.shape == labels.shape
+        row = paddle.text.Movielens()[0]
+        assert len(row) == 7
