@@ -131,4 +131,211 @@ class Bernoulli(Distribution):
 
 
 def kl_divergence(p, q):
+    fn = _registered_kl(p, q)
+    if fn is not None:
+        return fn(p, q)
     return p.kl_divergence(q)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    distribution/exponential_family.py): entropy via the Bregman identity
+    over the log-normalizer, computed with autodiff."""
+
+    # Subclasses implement entropy()/log_prob() directly (closed forms);
+    # the reference's Bregman-identity entropy over the log-normalizer is a
+    # fallback our concrete distributions don't need.
+
+
+class Beta(ExponentialFamily):
+    """reference distribution/beta.py."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = as_tensor(alpha, dtype="float32")
+        self.beta = as_tensor(beta, dtype="float32")
+
+    @property
+    def mean(self):
+        return eager_call("beta_mean", lambda a, b: a / (a + b), [self.alpha, self.beta])
+
+    @property
+    def variance(self):
+        return eager_call(
+            "beta_var",
+            lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+            [self.alpha, self.beta],
+        )
+
+    def sample(self, shape=()):
+        key = random_state.next_key()
+        a, b = self.alpha._data, self.beta._data
+        out_shape = tuple(shape) + np.broadcast_shapes(a.shape, b.shape)
+        return Tensor(jax.random.beta(key, a, b, out_shape or None), stop_gradient=True)
+
+    def log_prob(self, value):
+        return eager_call(
+            "beta_log_prob",
+            lambda a, b, v: (
+                (a - 1) * jnp.log(jnp.clip(v, 1e-12))
+                + (b - 1) * jnp.log(jnp.clip(1 - v, 1e-12))
+                - (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                   - jax.scipy.special.gammaln(a + b))
+            ),
+            [self.alpha, self.beta, as_tensor(value)],
+        )
+
+    def entropy(self):
+        return eager_call(
+            "beta_entropy",
+            lambda a, b: (
+                jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                - jax.scipy.special.gammaln(a + b)
+                - (a - 1) * jax.scipy.special.digamma(a)
+                - (b - 1) * jax.scipy.special.digamma(b)
+                + (a + b - 2) * jax.scipy.special.digamma(a + b)
+            ),
+            [self.alpha, self.beta],
+        )
+
+
+class Dirichlet(ExponentialFamily):
+    """reference distribution/dirichlet.py."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = as_tensor(concentration, dtype="float32")
+
+    @property
+    def mean(self):
+        return eager_call(
+            "dir_mean", lambda c: c / jnp.sum(c, -1, keepdims=True), [self.concentration]
+        )
+
+    @property
+    def variance(self):
+        def fn(c):
+            a0 = jnp.sum(c, -1, keepdims=True)
+            return c * (a0 - c) / (a0 * a0 * (a0 + 1))
+        return eager_call("dir_var", fn, [self.concentration])
+
+    def sample(self, shape=()):
+        key = random_state.next_key()
+        c = self.concentration._data
+        return Tensor(
+            jax.random.dirichlet(key, c, tuple(shape) + c.shape[:-1] or None),
+            stop_gradient=True,
+        )
+
+    def log_prob(self, value):
+        return eager_call(
+            "dir_log_prob",
+            lambda c, v: (
+                jnp.sum((c - 1) * jnp.log(jnp.clip(v, 1e-12)), -1)
+                + jax.scipy.special.gammaln(jnp.sum(c, -1))
+                - jnp.sum(jax.scipy.special.gammaln(c), -1)
+            ),
+            [self.concentration, as_tensor(value)],
+        )
+
+    def entropy(self):
+        def fn(c):
+            a0 = jnp.sum(c, -1)
+            K = c.shape[-1]
+            logB = jnp.sum(jax.scipy.special.gammaln(c), -1) - jax.scipy.special.gammaln(a0)
+            return (
+                logB + (a0 - K) * jax.scipy.special.digamma(a0)
+                - jnp.sum((c - 1) * jax.scipy.special.digamma(c), -1)
+            )
+        return eager_call("dir_entropy", fn, [self.concentration])
+
+
+class Multinomial(Distribution):
+    """reference distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_t = as_tensor(probs, dtype="float32")
+
+    @property
+    def mean(self):
+        return eager_call(
+            "multi_mean", lambda p, n=1: n * p, [self.probs_t],
+            attrs={"n": self.total_count},
+        )
+
+    @property
+    def variance(self):
+        return eager_call(
+            "multi_var", lambda p, n=1: n * p * (1 - p), [self.probs_t],
+            attrs={"n": self.total_count},
+        )
+
+    def sample(self, shape=()):
+        key = random_state.next_key()
+        p = self.probs_t._data
+        batch = p.shape[:-1]
+        # n independent categorical draws summed into counts (batched probs
+        # supported: draws carry shape (*shape, n, *batch))
+        draws = jax.random.categorical(
+            key, jnp.log(jnp.clip(p, 1e-12)),
+            shape=tuple(shape) + (self.total_count,) + batch,
+        )
+        counts = jax.nn.one_hot(draws, p.shape[-1]).sum(axis=len(shape))
+        return Tensor(counts, stop_gradient=True)
+
+    def log_prob(self, value):
+        return eager_call(
+            "multi_log_prob",
+            lambda p, v: (
+                jax.scipy.special.gammaln(jnp.sum(v, -1) + 1)
+                - jnp.sum(jax.scipy.special.gammaln(v + 1), -1)
+                + jnp.sum(v * jnp.log(jnp.clip(p, 1e-12)), -1)
+            ),
+            [self.probs_t, as_tensor(value)],
+        )
+
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """reference distribution/kl.py register_kl decorator."""
+
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
+def _registered_kl(p, q):
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return fn
+    return None
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def fn(a1, b1, a2, b2):
+        S1 = a1 + b1
+        return (
+            jax.scipy.special.gammaln(S1) - jax.scipy.special.gammaln(a1) - jax.scipy.special.gammaln(b1)
+            - (jax.scipy.special.gammaln(a2 + b2) - jax.scipy.special.gammaln(a2) - jax.scipy.special.gammaln(b2))
+            + (a1 - a2) * jax.scipy.special.digamma(a1)
+            + (b1 - b2) * jax.scipy.special.digamma(b1)
+            + (a2 - a1 + b2 - b1) * jax.scipy.special.digamma(S1)
+        )
+    return eager_call("kl_beta", fn, [p.alpha, p.beta, q.alpha, q.beta])
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dir_dir(p, q):
+    def fn(c1, c2):
+        a0 = jnp.sum(c1, -1)
+        return (
+            jax.scipy.special.gammaln(a0) - jnp.sum(jax.scipy.special.gammaln(c1), -1)
+            - jax.scipy.special.gammaln(jnp.sum(c2, -1)) + jnp.sum(jax.scipy.special.gammaln(c2), -1)
+            + jnp.sum((c1 - c2) * (jax.scipy.special.digamma(c1)
+                                   - jax.scipy.special.digamma(a0)[..., None]), -1)
+        )
+    return eager_call("kl_dir", fn, [p.concentration, q.concentration])

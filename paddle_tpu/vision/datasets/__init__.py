@@ -17,8 +17,6 @@ from ...io import Dataset
 
 from ...io import data_home
 
-_DATA_HOME = data_home()
-
 
 class MNIST(Dataset):
     def __init__(self, image_path=None, label_path=None, mode="train", transform=None, download=True, backend=None):
@@ -28,7 +26,7 @@ class MNIST(Dataset):
         self.images, self.labels = images, labels
 
     def _load(self):
-        base = os.path.join(_DATA_HOME, "mnist")
+        base = os.path.join(data_home(), "mnist")
         prefix = "train" if self.mode == "train" else "t10k"
         img_f = os.path.join(base, f"{prefix}-images-idx3-ubyte.gz")
         lab_f = os.path.join(base, f"{prefix}-labels-idx1-ubyte.gz")

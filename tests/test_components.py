@@ -246,3 +246,45 @@ class TestAutoParallel:
 
         cost = estimate_cost(lambda a, b: a @ b, jnp.ones((64, 64)), jnp.ones((64, 64)))
         assert cost["flops"] >= 2 * 64 * 64 * 64 * 0.9
+
+
+class TestDistributions:
+    """Beta/Dirichlet/Multinomial + registered KL — parity vs torch.distributions."""
+
+    def test_beta(self):
+        import torch
+        from paddle_tpu.distribution import Beta
+
+        pb, tb = Beta(2.5, 1.5), torch.distributions.Beta(2.5, 1.5)
+        np.testing.assert_allclose(
+            float(pb.log_prob(paddle.to_tensor(0.3)).numpy()),
+            float(tb.log_prob(torch.tensor(0.3))), rtol=1e-5,
+        )
+        np.testing.assert_allclose(float(pb.entropy().numpy()), float(tb.entropy()), rtol=1e-5)
+        s = pb.sample([200])
+        assert 0 < float(s.numpy().mean()) < 1
+
+    def test_dirichlet_and_multinomial(self):
+        import torch
+        from paddle_tpu.distribution import Dirichlet, Multinomial
+
+        c = np.array([1.5, 2.0, 3.0], np.float32)
+        pd, td = Dirichlet(c), torch.distributions.Dirichlet(torch.tensor(c))
+        v = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(
+            float(pd.log_prob(paddle.to_tensor(v)).numpy()),
+            float(td.log_prob(torch.tensor(v))), rtol=1e-5,
+        )
+        pm = Multinomial(10, np.array([0.2, 0.3, 0.5], np.float32))
+        assert (pm.sample([4]).numpy().sum(-1) == 10).all()
+
+    def test_registered_kl(self):
+        import torch
+        from paddle_tpu.distribution import Beta, kl_divergence
+
+        p, q = Beta(2.5, 1.5), Beta(1.2, 2.2)
+        tp, tq = torch.distributions.Beta(2.5, 1.5), torch.distributions.Beta(1.2, 2.2)
+        np.testing.assert_allclose(
+            float(kl_divergence(p, q).numpy()),
+            float(torch.distributions.kl_divergence(tp, tq)), rtol=1e-5,
+        )
