@@ -66,6 +66,17 @@ class StaticFunction:
         self._bwd_cache = {}
         self._last_lowered = None
 
+    def program(self, *example_inputs):
+        """Program view of the traced computation (reference
+        StaticFunction.main_program / ProgramDesc introspection): blocks,
+        ops, vars over the captured jaxpr."""
+        from ..static.program import Program
+
+        specs = list(example_inputs) or list(self._input_spec or [])
+        if not specs:
+            raise ValueError("program(): pass example inputs or set input_spec")
+        return Program.from_callable(self._fn, specs, layer=self._layer)
+
     def _params_buffers(self):
         if self._layer is None:
             return [], []

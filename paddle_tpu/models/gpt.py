@@ -242,6 +242,19 @@ class GPTForPretraining(nn.Layer):
             logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1])
         )
 
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
+                 top_p=1.0, eos_token_id=None, do_sample=True):
+        """KV-cached compiled autoregressive decoding (see
+        models/generation.py — prefill + lax.fori_loop sampling in ONE jitted
+        program; the reference's top_k/multinomial/beam_search op roles)."""
+        from .generation import generate as _generate
+
+        return _generate(
+            self, input_ids, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token_id=eos_token_id, do_sample=do_sample,
+        )
+
 
 def _transpose(w):
     from ..ops.manipulation import transpose

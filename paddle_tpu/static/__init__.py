@@ -82,3 +82,13 @@ def data(name, shape, dtype="float32", lod_level=0):
 
 # gradient clip re-exports for parity
 from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401,E402
+
+from .program import Program, Block, OpDesc, VarDesc  # noqa: F401,E402
+
+# control-flow ops under static.nn (reference paddle.static.nn.cond/while_loop)
+from ..ops import control_flow as nn  # noqa: E402  (module alias: static.nn)
+
+import sys as _sys  # noqa: E402
+
+# register the alias so `import paddle_tpu.static.nn` works (reference idiom)
+_sys.modules[__name__ + ".nn"] = nn

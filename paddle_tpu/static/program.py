@@ -1,0 +1,148 @@
+"""Program metadata — jaxpr-backed program introspection.
+
+Parity: reference Program IR (``framework.proto`` ProgramDesc/BlockDesc/
+OpDesc, ``python/paddle/fluid/framework.py`` Program/Block/Operator). The
+TPU-native program IS the traced jaxpr (then XLA HLO); this module exposes
+that trace through the reference's introspection surface: ``program.blocks``,
+``block.ops``, ``op.type``/``input_names``/``output_names``, ``block.vars``
+— so tooling that walks a Program (op counting, pass auditing, debugging)
+has the same handles.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+import jax
+
+from ..core.engine import no_grad
+from ..core.tensor import Tensor
+
+
+class OpDesc:
+    """One primitive application (reference framework.proto OpDesc)."""
+
+    def __init__(self, eqn):
+        self.type = str(eqn.primitive.name)
+        self.input_names = [str(v) for v in eqn.invars]
+        self.output_names = [str(v) for v in eqn.outvars]
+        self.attrs = {k: v for k, v in eqn.params.items()
+                      if isinstance(v, (int, float, str, bool, tuple))}
+
+    def __repr__(self):
+        return f"{{Op: {self.type}({', '.join(self.input_names)}) -> ({', '.join(self.output_names)})}}"
+
+
+class VarDesc:
+    def __init__(self, name, aval):
+        self.name = name
+        self.shape = list(getattr(aval, "shape", ()))
+        self.dtype = np.dtype(getattr(aval, "dtype", np.float32))
+
+    def __repr__(self):
+        return f"{{Var {self.name}: {self.dtype} {self.shape}}}"
+
+
+def _flat_eqns(jaxpr):
+    """Inline pjit/closed_call wrappers (the eager dispatch jits every op, so
+    without inlining the trace reads as a wall of 'pjit' eqns)."""
+    out = []
+    for e in jaxpr.eqns:
+        if e.primitive.name in ("pjit", "jit", "closed_call", "custom_jvp_call", "custom_vjp_call"):
+            inner = e.params.get("jaxpr") or e.params.get("call_jaxpr")
+            if inner is not None:
+                out.extend(_flat_eqns(getattr(inner, "jaxpr", inner)))
+                continue
+        out.append(e)
+    return out
+
+
+class Block:
+    """Reference BlockDesc: the op list + var table of one (sub)jaxpr."""
+
+    def __init__(self, jaxpr, idx=0):
+        self.idx = idx
+        eqns = _flat_eqns(jaxpr)
+        self.ops: List[OpDesc] = [OpDesc(e) for e in eqns]
+        self.vars: Dict[str, VarDesc] = {}
+        for v in list(jaxpr.invars) + [ov for e in eqns for ov in e.outvars]:
+            self.vars[str(v)] = VarDesc(str(v), v.aval)
+
+    def all_op_types(self):
+        return [op.type for op in self.ops]
+
+
+class Program:
+    """Reference Program over a traced computation."""
+
+    def __init__(self, closed_jaxpr):
+        self._jaxpr = closed_jaxpr
+        main = closed_jaxpr.jaxpr
+        self.blocks = [Block(main, 0)]
+        # sub-blocks: control-flow bodies (cond branches, scan/while bodies)
+        # mirror the reference's sub-BlockDescs
+        idx = 1
+        for eqn in _flat_eqns(main):
+            for key in ("jaxpr", "branches", "cond_jaxpr", "body_jaxpr", "call_jaxpr"):
+                sub = eqn.params.get(key)
+                if sub is None:
+                    continue
+                subs = sub if isinstance(sub, (tuple, list)) else [sub]
+                for sj in subs:
+                    inner = getattr(sj, "jaxpr", sj)
+                    if hasattr(inner, "eqns"):
+                        self.blocks.append(Block(inner, idx))
+                        idx += 1
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def num_ops(self):
+        return sum(len(b.ops) for b in self.blocks)
+
+    def __repr__(self):
+        return (
+            f"{{Program: {len(self.blocks)} block(s), {self.num_ops()} ops; "
+            f"main: {', '.join(self.global_block().all_op_types()[:12])}"
+            + ("…" if len(self.global_block().ops) > 12 else "") + "}"
+        )
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_callable(fn, input_specs: Sequence[Any], layer=None) -> "Program":
+        """Trace ``fn(*tensors)`` (a Layer or python fn over Tensors) at the
+        given InputSpecs/example tensors and return its Program view."""
+        from .input import InputSpec
+
+        layer = layer if layer is not None else (fn if hasattr(fn, "parameters") else None)
+        params = [p for _, p in layer.named_parameters()] if layer is not None else []
+        buffers = [b for _, b in layer.named_buffers()] if layer is not None else []
+
+        shapes = []
+        for s in input_specs:
+            if isinstance(s, InputSpec):
+                shape = tuple(1 if (d is None or d == -1) else int(d) for d in s.shape)
+                shapes.append(jax.ShapeDtypeStruct(shape, np.dtype(s.dtype)))
+            elif isinstance(s, Tensor):
+                shapes.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
+            else:
+                a = np.asarray(s)
+                shapes.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+
+        def pure(*arrays):
+            feed = arrays[: len(shapes)]
+            param_arrays = arrays[len(shapes):]
+            saved = [(t, t._data) for t in params + buffers]
+            try:
+                for t, a in zip(params, param_arrays):
+                    t._data = a
+                with no_grad():
+                    out = fn(*[Tensor(a, stop_gradient=True) for a in feed])
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+            finally:
+                for t, a in saved:
+                    t._data = a
+
+        closed = jax.make_jaxpr(pure)(*shapes, *[p._data for p in params])
+        return Program(closed)

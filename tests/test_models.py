@@ -168,3 +168,50 @@ class TestNewVisionFamilies:
         from paddle_tpu.vision.models import inception_v3
 
         self._check(inception_v3, size=128)
+
+
+class TestGeneration:
+    """KV-cached compiled decode (models/generation.py)."""
+
+    def _model(self):
+        from paddle_tpu.models.gpt import GPTForPretraining, gpt_tiny
+
+        paddle.seed(0)
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        m = GPTForPretraining(cfg)
+        m.eval()
+        return m, cfg
+
+    def test_greedy_matches_full_forward(self):
+        m, cfg = self._model()
+        prompt = paddle.to_tensor(np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)))
+        out = m.generate(prompt, max_new_tokens=5, do_sample=False)
+        ids = prompt.numpy().astype(np.int64)
+        for _ in range(5):
+            nxt = m(paddle.to_tensor(ids)).numpy()[:, -1].argmax(-1)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(out.numpy(), ids)
+
+    def test_topk1_equals_greedy_and_eos(self):
+        m, cfg = self._model()
+        prompt = paddle.to_tensor(np.random.RandomState(1).randint(0, cfg.vocab_size, (1, 6)))
+        greedy = m.generate(prompt, max_new_tokens=4, do_sample=False)
+        topk1 = m.generate(prompt, max_new_tokens=4, do_sample=True, top_k=1)
+        np.testing.assert_array_equal(greedy.numpy(), topk1.numpy())
+        eos = int(greedy.numpy()[0, 6])
+        out = m.generate(prompt, max_new_tokens=4, do_sample=False, eos_token_id=eos)
+        row = out.numpy()[0, 6:]
+        first = list(row).index(eos)
+        assert all(t == eos for t in row[first:])
+
+    def test_top_k_top_p_filtering(self):
+        import jax.numpy as jnp
+        from paddle_tpu.models.generation import top_k_top_p_filtering
+
+        logits = jnp.asarray(np.log(np.array([[0.5, 0.3, 0.15, 0.05]], np.float32)))
+        k2 = top_k_top_p_filtering(logits, top_k=2)
+        assert np.isfinite(np.asarray(k2)[0, :2]).all()
+        assert np.isinf(np.asarray(k2)[0, 2:]).all()
+        p8 = top_k_top_p_filtering(logits, top_p=0.8)
+        kept = np.isfinite(np.asarray(p8)[0])
+        assert kept[:2].all() and not kept[3]

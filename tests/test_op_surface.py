@@ -260,3 +260,43 @@ class TestTextDatasets:
         assert words.shape == pred.shape == labels.shape
         row = paddle.text.Movielens()[0]
         assert len(row) == 7
+
+
+class TestProgramIntrospection:
+    """Program IR view over traced computations (reference ProgramDesc/
+    BlockDesc/OpDesc introspection, SURVEY §2.1 Program IR row)."""
+
+    def test_linear_program_ops(self):
+        from paddle_tpu import nn
+        from paddle_tpu.static import InputSpec, Program
+
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        prog = Program.from_callable(m, [InputSpec([2, 4], "float32")])
+        types = prog.global_block().all_op_types()
+        assert types.count("dot_general") == 2
+        assert "max" in types  # relu
+        v = prog.global_block().vars
+        assert any(d.shape == [2, 4] for d in v.values())
+
+    def test_control_flow_subblocks(self):
+        from paddle_tpu.ops.control_flow import while_loop
+        from paddle_tpu.static import Program
+
+        def f(x):
+            out = while_loop(lambda i, a: i < 3, lambda i, a: (i + 1, a * 2),
+                             [paddle.to_tensor(0), x])
+            return out[1]
+
+        prog = Program.from_callable(f, [paddle.to_tensor(np.ones(2, np.float32))])
+        assert any(op.type == "while" for op in prog.global_block().ops)
+        assert len(prog.blocks) >= 2  # cond/body sub-blocks like sub-BlockDescs
+
+    def test_to_static_program(self):
+        from paddle_tpu.static import InputSpec
+
+        @paddle.jit.to_static
+        def f(a):
+            return paddle.tanh(a) * 2
+
+        prog = f.program(InputSpec([3], "float32"))
+        assert "tanh" in prog.global_block().all_op_types()
