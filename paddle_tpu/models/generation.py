@@ -4,14 +4,14 @@ Parity: the reference's decoding machinery (sampling ops ``top_k_op``/
 ``multinomial``, ``beam_search_op``/``beam_search_decode_op``, and the fluid
 decoder loops PaddleNLP builds on them). TPU-native formulation: the WHOLE
 decode — prefill, per-step cache update, logits, top-k/top-p filtering,
-sampling — is one jitted program per (prompt-shape, max-length): the step
-loop is a ``lax.fori_loop`` whose carry holds the KV caches, so tokens never
-bounce to the host between steps.
+sampling — is one jitted program per (architecture, prompt-shape,
+max-length): the step loop is a ``lax.fori_loop`` whose carry holds the KV
+caches, so tokens never bounce to the host between steps.
 
-Works with GPT-style models exposing:
-  model.gpt.embeddings(ids, position_ids), model.gpt.layers[i] blocks with
-  .ln1/.attn(.qkv/.proj/num_heads/head_dim)/.ln2/.mlp, model.gpt.final_ln,
-  tied LM head (embedding weight).
+Two architecture plugs share one loop driver:
+  GPT   — LayerNorm + learned positions + fused qkv + GELU MLP, tied head;
+  Llama — RMSNorm + RoPE at absolute cache positions + GQA (grouped-query
+          attention against the UN-repeated KV cache) + SwiGLU, untied head.
 """
 from __future__ import annotations
 
@@ -25,6 +25,8 @@ from jax import lax
 from ..core import random as random_state
 from ..core.engine import no_grad
 from ..core.tensor import Tensor
+
+_DECODE_CACHE = {}
 
 
 def top_k_top_p_filtering(logits, top_k=0, top_p=1.0):
@@ -46,7 +48,25 @@ def top_k_top_p_filtering(logits, top_k=0, top_p=1.0):
     return logits
 
 
-def _layer_weights(layer):
+def _grouped_attention(q, kc, vc, live, rep):
+    """Attention of q (B,T,H,D) against an UN-repeated KV cache
+    (B,Tk,KV,D): GQA via a grouped einsum — the repeats are never
+    materialized, so the cache streams once regardless of H/KV."""
+    B, T, H, D = q.shape
+    KV = kc.shape[2]
+    scale = jnp.asarray(1.0 / np.sqrt(D), q.dtype)
+    qg = q.reshape(B, T, KV, rep, D)
+    s = jnp.einsum("bqgrd,bkgd->bgrqk", qg, kc) * scale  # (B,KV,rep,T,Tk)
+    p = jax.nn.softmax(jnp.where(live, s, -jnp.inf), axis=-1)
+    o = jnp.einsum("bgrqk,bkgd->bqgrd", p, vc)
+    return o.reshape(B, T, H * D)
+
+
+# ---------------------------------------------------------------------------
+# GPT architecture plug
+# ---------------------------------------------------------------------------
+
+def _gpt_layer_weights(layer):
     a = layer.attn
     return {
         "ln1_w": layer.ln1.weight._data, "ln1_b": layer.ln1.bias._data,
@@ -64,36 +84,190 @@ def _ln(x, w, b, eps=1e-5):
     return (x - mu) / jnp.sqrt(var + eps) * w + b
 
 
-def _block(x, w, H, D, kv=None, pos=None):
-    """One decoder block, pure-array. kv=(k_cache, v_cache) enables cached
-    attention for a single-step x (B, 1, hidden); kv=None runs full causal
-    attention and returns this block's k/v for cache prefill."""
-    B, T = x.shape[0], x.shape[1]
-    h = _ln(x, w["ln1_w"], w["ln1_b"])
-    qkv = h @ w["qkv_w"] + w["qkv_b"]
-    qkv = qkv.reshape(B, T, 3, H, D)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    scale = jnp.asarray(1.0 / np.sqrt(D), x.dtype)  # keep x's dtype under x64
-    if kv is None:
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        p = jax.nn.softmax(jnp.where(mask[None, None], s, -jnp.inf), axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
-        new_kv = (k, v)
-    else:
-        kc, vc = kv  # (B, T_max, H, D)
-        kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
-        vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * scale  # (B,H,1,T_max)
-        live = (jnp.arange(kc.shape[1]) <= pos)[None, None, None, :]
-        p = jax.nn.softmax(jnp.where(live, s, -jnp.inf), axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, vc)
-        new_kv = (kc, vc)
-    o = o.reshape(B, T, H * D)
-    x = x + (o @ w["proj_w"] + w["proj_b"])
-    h2 = _ln(x, w["ln2_w"], w["ln2_b"])
-    ff = jax.nn.gelu(h2 @ w["up_w"] + w["up_b"], approximate=True) @ w["down_w"] + w["down_b"]
-    return x + ff, new_kv
+def _gpt_arch(H, D):
+    def embed_prompt(params, ids, T0):
+        return params["wte"][ids] + params["wpe"][jnp.arange(T0)][None]
+
+    def embed_token(params, tok, pos):
+        return params["wte"][tok][:, None] + params["wpe"][pos][None, None]
+
+    def block(w, x, kv=None, pos=None):
+        B, T = x.shape[0], x.shape[1]
+        h = _ln(x, w["ln1_w"], w["ln1_b"])
+        qkv = (h @ w["qkv_w"] + w["qkv_b"]).reshape(B, T, 3, H, D)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if kv is None:
+            live = jnp.tril(jnp.ones((T, T), bool))[None, None, None]
+            o = _grouped_attention(q, k, v, live, rep=1)
+            new_kv = (k, v)
+        else:
+            kc = lax.dynamic_update_slice(kv[0], k, (0, pos, 0, 0))
+            vc = lax.dynamic_update_slice(kv[1], v, (0, pos, 0, 0))
+            live = (jnp.arange(kc.shape[1]) <= pos)[None, None, None, None, :]
+            o = _grouped_attention(q, kc, vc, live, rep=1)
+            new_kv = (kc, vc)
+        x = x + (o @ w["proj_w"] + w["proj_b"])
+        h2 = _ln(x, w["ln2_w"], w["ln2_b"])
+        ff = jax.nn.gelu(h2 @ w["up_w"] + w["up_b"], approximate=True) @ w["down_w"] + w["down_b"]
+        return x + ff, new_kv
+
+    def head(params, x):
+        x = _ln(x, params["lnf_w"], params["lnf_b"])
+        return x[:, -1] @ params["wte"].T  # tied head
+
+    return {"embed_prompt": embed_prompt, "embed_token": embed_token,
+            "block": block, "head": head, "kv_heads": H, "head_dim": D}
+
+
+# ---------------------------------------------------------------------------
+# Llama architecture plug
+# ---------------------------------------------------------------------------
+
+def _llama_layer_weights(layer):
+    a = layer.self_attn
+    m = layer.mlp
+    return {
+        "ln1_w": layer.input_layernorm.weight._data,
+        "q_w": a.q_proj.weight._data, "k_w": a.k_proj.weight._data,
+        "v_w": a.v_proj.weight._data, "o_w": a.o_proj.weight._data,
+        "ln2_w": layer.post_attention_layernorm.weight._data,
+        "gate_w": m.gate_proj.weight._data, "up_w": m.up_proj.weight._data,
+        "down_w": m.down_proj.weight._data,
+    }
+
+
+def _rms(x, w, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+    return (x * lax.rsqrt(var + eps).astype(x.dtype)) * w
+
+
+def _rope_at(x, pos0, theta):
+    """Rotary embedding at absolute positions pos0 + [0..T)."""
+    B, T, H, D = x.shape
+    pos = pos0 + jnp.arange(T, dtype=jnp.float32)
+    inv = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    ang = pos[:, None] * inv[None, :]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+
+def _llama_arch(H, KV, D, theta, eps):
+    rep = H // KV
+
+    def embed_prompt(params, ids, T0):
+        return params["wte"][ids]
+
+    def embed_token(params, tok, pos):
+        return params["wte"][tok][:, None]
+
+    def block(w, x, kv=None, pos=None):
+        B, T = x.shape[0], x.shape[1]
+        h = _rms(x, w["ln1_w"], eps)
+        q = (h @ w["q_w"]).reshape(B, T, H, D)
+        k = (h @ w["k_w"]).reshape(B, T, KV, D)
+        v = (h @ w["v_w"]).reshape(B, T, KV, D)
+        pos0 = jnp.float32(0.0) if kv is None else pos.astype(jnp.float32)
+        q = _rope_at(q, pos0, theta)
+        k = _rope_at(k, pos0, theta)
+        if kv is None:
+            live = jnp.tril(jnp.ones((T, T), bool))[None, None, None]
+            o = _grouped_attention(q, k, v, live, rep)
+            new_kv = (k, v)  # cache the KV heads, not the repeats
+        else:
+            kc = lax.dynamic_update_slice(kv[0], k, (0, pos, 0, 0))
+            vc = lax.dynamic_update_slice(kv[1], v, (0, pos, 0, 0))
+            live = (jnp.arange(kc.shape[1]) <= pos)[None, None, None, None, :]
+            o = _grouped_attention(q, kc, vc, live, rep)
+            new_kv = (kc, vc)
+        x = x + o @ w["o_w"]
+        h2 = _rms(x, w["ln2_w"], eps)
+        ff = (jax.nn.silu(h2 @ w["gate_w"]) * (h2 @ w["up_w"])) @ w["down_w"]
+        return x + ff, new_kv
+
+    def head(params, x):
+        return _rms(x, params["lnf_w"], eps)[:, -1] @ params["head_w"]
+
+    return {"embed_prompt": embed_prompt, "embed_token": embed_token,
+            "block": block, "head": head, "kv_heads": KV, "head_dim": D}
+
+
+# ---------------------------------------------------------------------------
+# Shared decode driver
+# ---------------------------------------------------------------------------
+
+def _build_decode(arch, T0, T_max, max_new_tokens, temperature, top_k, top_p,
+                  eos_token_id, do_sample):
+    KV, D = arch["kv_heads"], arch["head_dim"]
+
+    def decode(params, ids, key):
+        layer_ws = params["layers"]
+        B = ids.shape[0]
+
+        # ---- prefill: full forward over the prompt, caches captured -------
+        x = arch["embed_prompt"](params, ids, T0)
+        caches = []
+        for w in layer_ws:
+            x, (k, v) = arch["block"](w, x)
+            kc = jnp.zeros((B, T_max, KV, D), x.dtype).at[:, :T0].set(k)
+            vc = jnp.zeros((B, T_max, KV, D), x.dtype).at[:, :T0].set(v)
+            caches.append((kc, vc))
+        logits0 = arch["head"](params, x)
+
+        out = jnp.zeros((B, T_max), jnp.int32).at[:, :T0].set(ids)
+        finished = jnp.zeros((B,), bool)
+
+        def sample_from(logits, key):
+            if do_sample:
+                logits = logits / max(temperature, 1e-6)
+                logits = top_k_top_p_filtering(logits, top_k, top_p)
+                return jax.random.categorical(key, logits, axis=-1)
+            return jnp.argmax(logits, axis=-1)
+
+        def step(i, carry):
+            out, caches, finished, key, logits = carry
+            key, sub = jax.random.split(key)
+            nxt = sample_from(logits, sub).astype(jnp.int32)
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            pos = T0 + i
+            out = lax.dynamic_update_slice(out, nxt[:, None], (0, pos))
+            x = arch["embed_token"](params, nxt, pos)
+            new_caches = []
+            for w, kv in zip(layer_ws, caches):
+                x, kv = arch["block"](w, x, kv=kv, pos=pos)
+                new_caches.append(kv)
+            logits = arch["head"](params, x)
+            return out, tuple(new_caches), finished, key, logits
+
+        out, _, _, _, _ = lax.fori_loop(
+            0, max_new_tokens, step,
+            (out, tuple(caches), finished, key, logits0),
+        )
+        return out
+
+    return decode
+
+
+def _run(arch_key, arch, params, ids_in, T0, max_new_tokens, temperature,
+         top_k, top_p, eos_token_id, do_sample):
+    B = ids_in.shape[0]
+    T_max = T0 + int(max_new_tokens)
+    key = random_state.next_key()
+    cache_key = arch_key + (B, T0, int(max_new_tokens), float(temperature),
+                            int(top_k), float(top_p), eos_token_id,
+                            bool(do_sample))
+    fn = _DECODE_CACHE.get(cache_key)
+    if fn is None:
+        fn = jax.jit(_build_decode(
+            arch, T0, T_max, int(max_new_tokens), float(temperature),
+            int(top_k), float(top_p), eos_token_id, bool(do_sample)))
+        _DECODE_CACHE[cache_key] = fn
+    return Tensor(fn(params, ids_in, key), stop_gradient=True)
 
 
 @no_grad()
@@ -117,14 +291,12 @@ def generate(
 
     ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
-    B, T0 = ids.shape
-    T_max = T0 + int(max_new_tokens)
-    if T_max > cfg.max_position_embeddings:
+    T0 = ids.shape[1]
+    if T0 + int(max_new_tokens) > cfg.max_position_embeddings:
         raise ValueError(
-            f"generate: {T_max} exceeds max_position_embeddings "
-            f"{cfg.max_position_embeddings}"
+            f"generate: {T0 + int(max_new_tokens)} exceeds "
+            f"max_position_embeddings {cfg.max_position_embeddings}"
         )
-
     qkv_w = gpt.layers[0].attn.qkv.weight._data
     if qkv_w.shape[-1] != 3 * cfg.hidden_size:
         raise NotImplementedError(
@@ -138,82 +310,43 @@ def generate(
         "wpe": gpt.embeddings.position_embeddings.weight._data,
         "lnf_w": gpt.final_ln.weight._data,
         "lnf_b": gpt.final_ln.bias._data,
-        "layers": [_layer_weights(l) for l in gpt.layers],
+        "layers": [_gpt_layer_weights(l) for l in gpt.layers],
     }
-    key = random_state.next_key()
-
-    # cache by architecture + decode config (NOT id(model): the fn takes all
-    # weights as arguments, so it is model-independent)
-    cache_key = (H, D, len(params["layers"]), B, T0, int(max_new_tokens),
-                 float(temperature), int(top_k), float(top_p), eos_token_id,
-                 bool(do_sample))
-    fn = _DECODE_CACHE.get(cache_key)
-    if fn is None:
-        fn = jax.jit(
-            _build_decode(H, D, T0, T_max, int(max_new_tokens),
-                          float(temperature), int(top_k), float(top_p),
-                          eos_token_id, bool(do_sample))
-        )
-        _DECODE_CACHE[cache_key] = fn
-    out = fn(params, ids, key)
-    return Tensor(out, stop_gradient=True)
+    arch_key = ("gpt", H, D, len(params["layers"]))
+    return _run(arch_key, _gpt_arch(H, D), params, ids, T0, max_new_tokens,
+                temperature, top_k, top_p, eos_token_id, do_sample)
 
 
-_DECODE_CACHE = {}
+@no_grad()
+def generate_llama(
+    model, input_ids, max_new_tokens=32, temperature=1.0, top_k=0, top_p=1.0,
+    eos_token_id=None, do_sample=True,
+):
+    """KV-cached compiled decode for LlamaForCausalLM: RoPE applied at
+    absolute cache positions; GQA attends against the un-repeated KV cache."""
+    cfg = model.model.config
+    H = cfg.num_heads
+    KV = cfg.kv_heads
+    D = cfg.hidden_size // H
 
+    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    T0 = ids.shape[1]
+    if T0 + int(max_new_tokens) > cfg.max_position_embeddings:
+        raise ValueError("generate: length exceeds max_position_embeddings")
+    q_w = model.model.layers[0].self_attn.q_proj.weight._data
+    if q_w.shape[-1] != cfg.hidden_size:
+        raise NotImplementedError("generate: physically mp-sharded weights")
 
-def _build_decode(H, D, T0, T_max, max_new_tokens, temperature, top_k, top_p,
-                  eos_token_id, do_sample):
-    def decode(params, ids, key):
-        wte, wpe = params["wte"], params["wpe"]
-        lnf_w, lnf_b = params["lnf_w"], params["lnf_b"]
-        layer_ws = params["layers"]
-        B = ids.shape[0]
-
-        # ---- prefill: full forward over the prompt, caches captured -------
-        x = wte[ids] + wpe[jnp.arange(T0)][None]
-        caches = []
-        for w in layer_ws:
-            x, (k, v) = _block(x, w, H, D)
-            kc = jnp.zeros((B, T_max, H, D), x.dtype).at[:, :T0].set(k)
-            vc = jnp.zeros((B, T_max, H, D), x.dtype).at[:, :T0].set(v)
-            caches.append((kc, vc))
-        x = _ln(x, lnf_w, lnf_b)
-        logits0 = x[:, -1] @ wte.T  # tied head
-
-        out = jnp.zeros((B, T_max), jnp.int32).at[:, :T0].set(ids)
-        finished = jnp.zeros((B,), bool)
-
-        def sample_from(logits, key):
-            if do_sample:
-                logits = logits / max(temperature, 1e-6)
-                logits = top_k_top_p_filtering(logits, top_k, top_p)
-                return jax.random.categorical(key, logits, axis=-1)
-            return jnp.argmax(logits, axis=-1)
-
-        def step(i, carry):
-            out, caches, finished, key, logits = carry
-            key, sub = jax.random.split(key)
-            nxt = sample_from(logits, sub).astype(jnp.int32)
-            if eos_token_id is not None:
-                nxt = jnp.where(finished, eos_token_id, nxt)
-                finished = finished | (nxt == eos_token_id)
-            pos = T0 + i
-            out = lax.dynamic_update_slice(out, nxt[:, None], (0, pos))
-            # one-token forward with cache
-            x = wte[nxt][:, None] + wpe[pos][None, None]
-            new_caches = []
-            for w, kv in zip(layer_ws, caches):
-                x, kv = _block(x, w, H, D, kv=kv, pos=pos)
-                new_caches.append(kv)
-            x = _ln(x, lnf_w, lnf_b)
-            logits = x[:, -1] @ wte.T
-            return out, tuple(new_caches), finished, key, logits
-
-        out, _, _, _, _ = lax.fori_loop(
-            0, max_new_tokens, step,
-            (out, tuple(caches), finished, key, logits0),
-        )
-        return out
-
-    return decode
+    params = {
+        "wte": model.model.embed_tokens.weight._data,
+        "lnf_w": model.model.norm.weight._data,
+        "head_w": model.lm_head.weight._data,
+        "layers": [_llama_layer_weights(l) for l in model.model.layers],
+    }
+    # theta/eps are baked into the compiled fn: they MUST key the cache
+    arch_key = ("llama", H, KV, D, len(params["layers"]),
+                float(cfg.rope_theta), float(cfg.rms_norm_eps))
+    arch = _llama_arch(H, KV, D, float(cfg.rope_theta), float(cfg.rms_norm_eps))
+    return _run(arch_key, arch, params, ids, T0, max_new_tokens,
+                temperature, top_k, top_p, eos_token_id, do_sample)

@@ -173,6 +173,18 @@ class LlamaForCausalLM(nn.Layer):
         logits = self(input_ids)
         return F.cross_entropy(logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
 
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
+                 top_p=1.0, eos_token_id=None, do_sample=True):
+        """KV-cached compiled decode (models/generation.py Llama path: RoPE
+        at absolute cache positions, GQA caches only KV heads)."""
+        from .generation import generate_llama
+
+        return generate_llama(
+            self, input_ids, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token_id=eos_token_id, do_sample=do_sample,
+        )
+
 
 def llama_tiny(**kw):
     return LlamaConfig(vocab_size=1024, hidden_size=128, num_layers=4, num_heads=4, max_position_embeddings=256, **kw)

@@ -215,3 +215,18 @@ class TestGeneration:
         p8 = top_k_top_p_filtering(logits, top_p=0.8)
         kept = np.isfinite(np.asarray(p8)[0])
         assert kept[:2].all() and not kept[3]
+
+    def test_llama_greedy_matches_full_forward_gqa(self):
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        paddle.seed(0)
+        cfg = llama_tiny(num_kv_heads=2)  # GQA path
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        prompt = paddle.to_tensor(np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 7)))
+        out = m.generate(prompt, max_new_tokens=4, do_sample=False)
+        ids = prompt.numpy().astype(np.int64)
+        for _ in range(4):
+            nxt = m(paddle.to_tensor(ids)).numpy()[:, -1].argmax(-1)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(out.numpy(), ids)
