@@ -171,3 +171,109 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None, s
             {"epochs": epochs, "steps": steps, "verbose": verbose, "metrics": metrics or []}
         )
     return cl
+
+
+class ReduceLROnPlateau(Callback):
+    """Reference hapi/callbacks.py ReduceLROnPlateau:958 — shrink the
+    optimizer LR when the monitored metric plateaus."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = "min" if mode in ("auto", "min") else "max"
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        val = (logs or {}).get(self.monitor)
+        opt = getattr(self.model, "_optimizer", None) if self.model else None
+        if val is None or opt is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        better = (
+            self.best is None
+            or (self.mode == "min" and val < self.best - self.min_delta)
+            or (self.mode == "max" and val > self.best + self.min_delta)
+        )
+        if better:
+            self.best = val
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                from ..optimizer.lr import LRScheduler as _Sched
+
+                if isinstance(getattr(opt, "_learning_rate", None), _Sched):
+                    # reference raises here: set_lr on a scheduler-driven
+                    # optimizer would silently kill the schedule
+                    raise TypeError(
+                        "ReduceLROnPlateau cannot adjust an optimizer driven "
+                        "by an LRScheduler; use optimizer.lr.ReduceOnPlateau "
+                        "as the scheduler instead"
+                    )
+                old = float(opt.get_lr())
+                new = max(old * self.factor, self.min_lr)
+                if new < old:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {old:g} -> {new:g}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """Reference hapi/callbacks.py VisualDL:843. The visualdl service isn't
+    available here (zero egress), so scalars stream to
+    ``<log_dir>/scalars.jsonl`` — same callback surface, greppable output."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self._fh = None
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+        import time
+
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+        # global_step is ours (monotonic across epochs); logs may carry its
+        # own per-epoch 'step' key, which must not clobber it
+        rec = {"global_step": self._step, "tag": tag, "ts": time.time()}
+        for k, v in (logs or {}).items():
+            if k in ("global_step", "tag", "ts"):
+                continue
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                pass
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % 10 == 0:
+            self._write("train", logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("train_epoch", logs)
+
+    def on_end(self, mode, logs=None):
+        # the harness delivers end-of-run as on_end(mode, logs)
+        if mode == "eval":
+            self._write("eval", logs)
+        if mode == "train" and self._fh is not None:
+            self._fh.close()
+            self._fh = None

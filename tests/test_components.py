@@ -288,3 +288,33 @@ class TestDistributions:
             float(kl_divergence(p, q).numpy()),
             float(torch.distributions.kl_divergence(tp, tq)), rtol=1e-5,
         )
+
+
+class TestCallbacks:
+    def test_reduce_lr_on_plateau_and_visualdl(self, tmp_path):
+        import json
+
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau, VisualDL
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+        model.prepare(opt, nn.MSELoss())
+        rng = np.random.RandomState(0)
+        X, Y = rng.rand(32, 4).astype(np.float32), rng.rand(32, 2).astype(np.float32)
+
+        class DS(paddle.io.Dataset):
+            def __getitem__(self, i):
+                return X[i], Y[i]
+
+            def __len__(self):
+                return 32
+
+        rl = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               min_delta=10.0, verbose=0)  # forced plateau
+        model.fit(DS(), epochs=3, batch_size=16, verbose=0,
+                  callbacks=[rl, VisualDL(str(tmp_path))])
+        assert float(opt.get_lr()) < 0.05
+        recs = [json.loads(l) for l in open(tmp_path / "scalars.jsonl")]
+        assert any(r["tag"] == "train_epoch" for r in recs)
