@@ -77,6 +77,75 @@ def bench_gpt(paddle, jax, np, on_tpu):
     }
 
 
+def _gpt_train_tokens_per_sec(paddle, np, cfg, batch, seq, steps):
+    from paddle_tpu.models.gpt import GPTForPretraining
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = paddle.jit.compile_train_step(model, lambda m, i, l: m.loss(i, l), opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    loss = step(ids, labels)
+    loss = step(ids, labels)
+    float(loss.item())
+    t0 = time.time()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    final = float(loss.item())
+    dt = time.time() - t0
+    n_params = sum(p.size for p in model.parameters())
+    return batch * seq * steps / dt, n_params, final
+
+
+def bench_gpt_1p3b(paddle, jax, np, on_tpu):
+    """North-star config: GPT-3 1.3B training on ONE chip — bf16 params+opt
+    states, per-layer remat, fused LM-head CE (BASELINE.json 1.3B-class)."""
+    from paddle_tpu.models.gpt import gpt3_1p3b
+
+    if not on_tpu:
+        return {"name": "GPT-1.3B single-chip (remat)", "skipped": "cpu"}
+    cfg = gpt3_1p3b(
+        hidden_dropout=0.0, attention_dropout=0.0, remat=True,
+        use_mp_layers=False,
+    )
+    batch, seq, steps = 4, 2048, 8
+    tps, n_params, final = _gpt_train_tokens_per_sec(paddle, np, cfg, batch, seq, steps)
+    flops_per_token = 6.0 * n_params + 6.0 * cfg.num_layers * cfg.hidden_size * seq
+    return {
+        "name": f"GPT-1.3B bf16 train (b{batch}xs{seq}, remat+fused-CE, single chip)",
+        "tokens_per_sec": round(tps, 1),
+        "mfu": round(tps * flops_per_token / _V5E_PEAK_BF16, 4),
+        "loss": round(final, 4),
+    }
+
+
+def bench_gpt_8k_flash(paddle, jax, np, on_tpu):
+    """Long-sequence point: 8k tokens through the Pallas flash-attention
+    kernel (fwd+bwd), where exact attention's T² scores would dominate."""
+    from paddle_tpu.models.gpt import GPTConfig
+
+    if not on_tpu:
+        return {"name": "GPT 8k flash", "skipped": "cpu"}
+    cfg = GPTConfig(
+        vocab_size=50304, hidden_size=1024, num_layers=12, num_heads=16,
+        max_position_embeddings=8192, hidden_dropout=0.0,
+        attention_dropout=0.0, attention_impl="flash", remat=True,
+        use_mp_layers=False,
+    )
+    batch, seq, steps = 2, 8192, 10
+    tps, n_params, final = _gpt_train_tokens_per_sec(paddle, np, cfg, batch, seq, steps)
+    flops_per_token = 6.0 * n_params + 6.0 * cfg.num_layers * cfg.hidden_size * seq
+    return {
+        "name": f"GPT-{n_params/1e6:.0f}M bf16 train (b{batch}xs8192, flash attention)",
+        "tokens_per_sec": round(tps, 1),
+        "mfu": round(tps * flops_per_token / _V5E_PEAK_BF16, 4),
+        "loss": round(final, 4),
+    }
+
+
 def bench_resnet50_aot(paddle, jax, np, on_tpu):
     """ResNet-50 AOT inference through the deployment path (save → Predictor)."""
     from paddle_tpu.vision.models import resnet50
@@ -147,7 +216,7 @@ def bench_lenet_eager(paddle, jax, np, on_tpu):
     float(loss.item())
     dt = time.time() - t0
     return {
-        "name": "LeNet eager train (b64, per-op dispatch)",
+        "name": "LeNet eager train (b64, lazy batched dispatch)",
         "steps_per_sec": round(steps / dt, 2),
     }
 
@@ -163,7 +232,7 @@ def main():
 
     gpt = bench_gpt(paddle, jax, np, on_tpu)
     extras = []
-    for fn in (bench_resnet50_aot, bench_lenet_eager):
+    for fn in (bench_resnet50_aot, bench_lenet_eager, bench_gpt_1p3b, bench_gpt_8k_flash):
         try:
             extras.append(fn(paddle, jax, np, on_tpu))
         except Exception as e:  # a broken extra must not kill the primary line

@@ -17,10 +17,13 @@ from __future__ import annotations
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
-# fp32 means fp32 (reference kernel semantics): without this, f32 matmuls
-# drop to bf16 passes on MXU-like backends. The perf path uses real bf16
-# dtypes (AMP), which is unaffected by this setting.
-_jax.config.update("jax_default_matmul_precision", "highest")
+# Matmul/conv precision is left at JAX's default. The reference's own fp32
+# default is TF32 tensor cores on Ampere (cuDNN/cuBLAS allow_tf32=true),
+# which corresponds to the MXU's default bf16-pass mode — while forcing
+# "highest" makes every fp32 conv a multi-pass emulation that the TPU
+# compiler autotunes pathologically slowly (minutes-long compiles for
+# conv grads) and that runs ~3-6x slower. fp64 stays exact; use
+# `with jax.default_matmul_precision("highest")` for reference-exact fp32.
 
 # Core types -----------------------------------------------------------------
 from .core.dtype import (  # noqa: F401

@@ -19,6 +19,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 import jax
 
+from . import lazy as lazy_mod
 from .engine import GradNode, grad_enabled
 from .tensor import Tensor
 
@@ -52,17 +53,7 @@ _jit_cache: "collections.OrderedDict" = collections.OrderedDict()
 _JIT_CACHE_MAX = 4096
 
 
-def _fn_key(fn):
-    try:
-        cells = tuple(c.cell_contents for c in (getattr(fn, "__closure__", None) or ()))
-        defaults = getattr(fn, "__defaults__", None) or ()
-        kwdefaults = tuple(sorted((getattr(fn, "__kwdefaults__", None) or {}).items()))
-        code = getattr(fn, "__code__", None)
-        key = (code, cells, defaults, kwdefaults) if code is not None else fn
-        hash(key)
-        return key
-    except (TypeError, ValueError, AttributeError):
-        return fn  # unhashable closure → identity key (no sharing, still cached)
+_fn_key = lazy_mod._fn_key  # one implementation; key includes kw-only defaults
 
 
 def _get_jitted(fn, attrs):
@@ -105,6 +96,7 @@ def eager_call(
     attrs: Optional[dict] = None,
     differentiable: bool = True,
     nondiff_outputs: Sequence[int] = (),
+    fn_key=None,
 ):
     """Run one op eagerly; record a GradNode if any input needs grad.
 
@@ -126,6 +118,27 @@ def eager_call(
 
     check_naninf = _flags.flag("FLAGS_check_nan_inf", False)
 
+    # Lazy batching path: queue the op; execution happens in one XLA
+    # computation at the next materialization point. Bypassed under jit
+    # tracing (tracer inputs), in debug nan-check mode, and for unhashable
+    # attrs (no stable executable-cache key).
+    has_tracer = any(isinstance(a, jax.core.Tracer) for a in arrays)
+    if not check_naninf and not has_tracer and lazy_mod.lazy_enabled():
+        try:
+            attrs_key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+            hash(attrs_key)
+        except TypeError:
+            attrs_key = None
+        if attrs_key is not None:
+            return _lazy_eager_call(
+                name, fn, tensor_args, arrays, attrs, attrs_key,
+                need_grad, nondiff_outputs, fn_key=fn_key,
+            )
+    if any(lazy_mod.is_lazy(a) for a in arrays):
+        # per-op path (tracing / debug / unhashable attrs): jit args must be
+        # real buffers, so pending lazy values materialize here
+        arrays = tuple(lazy_mod.concrete(a) for a in arrays)
+
     if not need_grad:
         outs = _get_jitted(fn, attrs)(*arrays)
         single = not isinstance(outs, (tuple, list))
@@ -133,6 +146,22 @@ def eager_call(
             _check_nan_inf(name, (outs,) if single else outs)
         outs_t = [Tensor(o, stop_gradient=True) for o in ((outs,) if single else outs)]
         return outs_t[0] if single else outs_t
+
+    # Differentiate ONLY wrt inputs that need grad (stop_gradient inputs are
+    # closed over as constants). Skips dead grad work and avoids an XLA TPU
+    # pathology: one program computing a conv's d/dinput AND d/dweight
+    # compiles ~10-100x slower than either alone.
+    need_idx = tuple(i for i, t in enumerate(tensor_args) if not t.stop_gradient)
+    diff_arrays = tuple(arrays[i] for i in need_idx)
+
+    def _over_diff(base_fn):
+        def f(*dxs):
+            full = list(arrays)
+            for j, i in enumerate(need_idx):
+                full[i] = dxs[j]
+            return base_fn(*full)
+
+        return f
 
     if nondiff_outputs:
         nondiff = set(nondiff_outputs)
@@ -149,7 +178,7 @@ def eager_call(
             res = res if isinstance(res, (tuple, list)) else (res,)
             return tuple(res[i] for i in diff_idx), tuple(res[i] for i in sorted(nondiff))
 
-        diff_outs, vjp_fn, aux = jax.vjp(split_fn, *arrays, has_aux=True)
+        diff_outs, raw_vjp, aux = jax.vjp(_over_diff(split_fn), *diff_arrays, has_aux=True)
         outs = [None] * n_out
         for j, i in enumerate(diff_idx):
             outs[i] = diff_outs[j]
@@ -160,11 +189,20 @@ def eager_call(
         diff_list = list(diff_outs)
     else:
         # jax.vjp natively handles tuple outputs: cotangent structure matches.
-        outs, vjp_fn = jax.vjp(lambda *xs: fn(*xs, **attrs), *arrays)
+        outs, raw_vjp = jax.vjp(_over_diff(lambda *xs: fn(*xs, **attrs)), *diff_arrays)
         multi = isinstance(outs, (tuple, list))
         outs = list(outs) if multi else [outs]
         node_out_idx = {i: i for i in range(len(outs))}
         diff_list = outs
+
+    def vjp_fn(cts, _raw=raw_vjp, _n=len(arrays), _idx=need_idx):
+        gs = _raw(cts)
+        if not isinstance(gs, tuple):
+            gs = (gs,)
+        full = [None] * _n
+        for j, i in enumerate(_idx):
+            full[i] = gs[j]
+        return tuple(full)
 
     routes = []
     for t in tensor_args:
@@ -202,6 +240,103 @@ def eager_call(
         outs_t.append(t)
     node.out_tensors = refs
     if len(outs_t) == 1 and not multi:
+        return outs_t[0]
+    return outs_t
+
+
+def _lazy_eager_call(
+    name, fn, tensor_args, arrays, attrs, attrs_key, need_grad, nondiff_outputs,
+    fn_key=None,
+):
+    """Record the op into the lazy graph instead of executing it; autograd
+    defers jax.vjp into the graph too (vjp composes under tracing), so a
+    whole backward()+optimizer.step()+next-forward chain flushes as ONE
+    compiled XLA computation."""
+    key = ((fn_key if fn_key is not None else _fn_key(fn)), attrs_key)
+    fwd = lambda *xs: fn(*xs, **attrs)
+
+    outs, single = lazy_mod.record(name, fwd, list(arrays), key=key)
+
+    if not need_grad:
+        outs_t = [Tensor(o, stop_gradient=True) for o in outs]
+        return outs_t[0] if single else outs_t
+
+    n_out = len(outs)
+    nondiff = set(nondiff_outputs or ())
+    diff_idx = [i for i in range(n_out) if i not in nondiff]
+    if nondiff:
+        def diff_fn(*xs, _idx=tuple(diff_idx)):
+            res = fn(*xs, **attrs)
+            res = res if isinstance(res, (tuple, list)) else (res,)
+            return tuple(res[i] for i in _idx)
+
+        vjp_multi = True
+    else:
+        diff_fn = fwd
+        vjp_multi = not single
+
+    n_in = len(arrays)
+    # Differentiate ONLY wrt inputs that need grad. Besides skipping dead
+    # work, this avoids an XLA TPU pathology where a conv that computes
+    # d/dinput and d/dweight in one program compiles ~10-100x slower than
+    # either alone (data inputs are stop_gradient, so the common case is
+    # weight-only).
+    need_idx = tuple(i for i, t in enumerate(tensor_args) if not t.stop_gradient)
+    vjp_key = ("vjp", key, vjp_multi, n_in, tuple(sorted(nondiff)), need_idx)
+
+    def deferred_vjp(cts):
+        cts_list = list(cts) if vjp_multi else [cts]
+
+        def bwd(*flat):
+            xs = flat[:n_in]
+            c = flat[n_in:]
+
+            def f(*diff_xs):
+                full = list(xs)
+                for j, i in enumerate(need_idx):
+                    full[i] = diff_xs[j]
+                return diff_fn(*full)
+
+            _, vjp = jax.vjp(f, *(xs[i] for i in need_idx))
+            return vjp(tuple(c) if vjp_multi else c[0])
+
+        outs_b, _ = lazy_mod.record(
+            "vjp_" + name, bwd, list(arrays) + cts_list, key=vjp_key
+        )
+        grads = [None] * n_in
+        for j, i in enumerate(need_idx):
+            grads[i] = outs_b[j]
+        return tuple(grads)
+
+    routes = []
+    for t in tensor_args:
+        if t.stop_gradient:
+            routes.append(None)
+        elif t._grad_node is not None:
+            routes.append(("node", t._grad_node, t._out_index))
+        else:
+            routes.append(("leaf", t))
+
+    out_avals = [(tuple(outs[i].shape), outs[i].dtype) for i in diff_idx]
+    node = GradNode(name, deferred_vjp, routes, out_avals, multi=vjp_multi)
+    node.replay = (diff_fn, list(tensor_args), vjp_multi)
+    node.replay_key = ("lz", key, vjp_multi, tuple(sorted(nondiff)))
+    node.replay_arrays = list(arrays)  # forward-time input values
+
+    node_out_idx = {i: j for j, i in enumerate(diff_idx)}
+    outs_t = []
+    refs = [None] * len(diff_idx)
+    for i, o in enumerate(outs):
+        if i in node_out_idx:
+            t = Tensor(o, stop_gradient=False)
+            t._grad_node = node
+            t._out_index = node_out_idx[i]
+            refs[node_out_idx[i]] = weakref.ref(t)
+        else:
+            t = Tensor(o, stop_gradient=True)
+        outs_t.append(t)
+    node.out_tensors = refs
+    if len(outs_t) == 1 and single:
         return outs_t[0]
     return outs_t
 

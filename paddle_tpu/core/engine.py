@@ -87,6 +87,8 @@ class GradNode:
         "post_hooks",
         "multi",
         "replay",
+        "replay_key",
+        "replay_arrays",
     )
 
     def __init__(self, name: str, vjp_fn: Callable, input_routes, out_avals, multi=False):
@@ -98,6 +100,233 @@ class GradNode:
         self.post_hooks = []
         self.multi = multi  # vjp expects a tuple cotangent
         self.replay = None  # (diff_fn, input_tensors, multi) for create_graph
+        self.replay_key = None  # stable identity of replay[0] (tape-bwd cache)
+        self.replay_arrays = None  # input VALUES captured at forward time
+
+
+# --------------------------------------------------------------------------
+# Tape-level backward (lazy mode fast path)
+# --------------------------------------------------------------------------
+class _TapeFallback(Exception):
+    pass
+
+
+def _tape_backward(roots, grad_tensors, retain_graph):
+    """Single-vjp backward: compose every recorded op's forward (GradNode
+    .replay) into ONE function of the grad-requiring leaves and record ONE
+    ``jax.vjp`` node over it. This reproduces exactly the program structure
+    of a hand-written ``jax.value_and_grad`` step — one instance of each
+    forward op inside the vjp — which XLA compiles orders of magnitude
+    faster than a chain of per-op vjp subprograms (a TPU compiler pathology:
+    modules with many separately-derived conv grads explode compile time).
+
+    Returns {} on success, None to fall back to the per-node engine (hooks,
+    PyLayer-style custom vjp without replay info, capture, create_graph).
+    """
+    from . import lazy as lazy_mod
+    from .tensor import Tensor
+
+    if any(isinstance(t._data, jax.core.Tracer) for t in roots):
+        return None
+
+    def _check(gn):
+        if gn.replay is None or gn.vjp_fn is None or gn.post_hooks:
+            raise _TapeFallback
+        if gn.out_tensors:
+            for r in gn.out_tensors:
+                t = r() if callable(r) else None
+                if t is not None and t._backward_hooks:
+                    raise _TapeFallback
+
+    def _children(gn):
+        return [r[1] for r in gn.input_routes if r is not None and r[0] == "node"]
+
+    # iterative post-order DFS (deep chains must not hit the Python
+    # recursion limit — the per-node engine this path replaces is iterative)
+    nodes, state = [], {}
+    try:
+        for t in roots:
+            gn = t._grad_node
+            if gn is None or id(gn) in state:
+                continue
+            _check(gn)
+            state[id(gn)] = 0
+            stack = [(gn, iter(_children(gn)))]
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for child in it:
+                    if id(child) not in state:
+                        _check(child)
+                        state[id(child)] = 0
+                        stack.append((child, iter(_children(child))))
+                        advanced = True
+                        break
+                if not advanced:
+                    nodes.append(node)
+                    stack.pop()
+    except _TapeFallback:
+        return None
+    if not nodes:
+        return None
+
+    node_ix = {id(n): i for i, n in enumerate(nodes)}
+    diff_leaves, const_inputs = [], []
+    leaf_ix, const_ix = {}, {}
+    descs, sig = [], []
+    from .dispatch import _fn_key
+
+    leaf_values = []
+    for n in nodes:
+        diff_fn, in_tensors, _multi = n.replay
+        # gradients must be taken at the values CAPTURED at forward time, not
+        # at the tensors' current _data (a _set_data between forward and
+        # backward must not change the result — vjp-closure semantics)
+        arrs = n.replay_arrays
+        for k, t in enumerate(in_tensors):
+            a = arrs[k] if arrs is not None else t._data
+            dn_kind = n.input_routes[k]
+            if dn_kind is None:
+                j = const_ix.get(id(t))
+                if j is None:
+                    j = len(const_inputs)
+                    const_ix[id(t)] = j
+                    const_inputs.append(a)
+            elif dn_kind[0] == "leaf":
+                t2 = dn_kind[1]
+                j = leaf_ix.get(id(t2))
+                if j is None:
+                    j = len(diff_leaves)
+                    leaf_ix[id(t2)] = j
+                    diff_leaves.append(t2)
+                    leaf_values.append(a)
+        dn = []
+        for t, route in zip(in_tensors, n.input_routes):
+            if route is None:
+                dn.append(("c", const_ix[id(t)]))
+            elif route[0] == "node":
+                dn.append(("n", node_ix[id(route[1])], route[2]))
+            else:
+                dn.append(("l", leaf_ix[id(route[1])]))
+        descs.append(tuple(dn))
+        rk = n.replay_key
+        if rk is None:
+            try:
+                rk = _fn_key(diff_fn)
+                hash(rk)
+            except Exception:
+                return None  # unstable identity would recompile per step
+        sig.append((n.name, rk, tuple(dn)))
+    if not diff_leaves:
+        return None
+
+    # root refs + cotangent seeds
+    root_refs, cts = [], []
+    for t, g in zip(roots, grad_tensors):
+        if t._grad_node is None:
+            continue  # leaf root: seeded by caller path below
+        root_refs.append(("n", node_ix[id(t._grad_node)], t._out_index))
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar tensor requires grad_tensors"
+                )
+            cts.append(
+                lazy_mod.lazy_full(tuple(t._data.shape), t._data.dtype, 1.0, name="grad_seed")
+            )
+        else:
+            cts.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
+
+    replays = [n.replay[0] for n in nodes]
+    nL, nC = len(diff_leaves), len(const_inputs)
+    root_refs_t = tuple(root_refs)
+
+    def tape_bwd(*flat):
+        lv_outer = flat[:nL]
+        consts_v = flat[nL : nL + nC]
+        cts_v = flat[nL + nC :]
+
+        def fwd_fn(*lv):
+            env = [None] * len(replays)
+            for i, f in enumerate(replays):
+                args = []
+                for d in descs[i]:
+                    if d[0] == "l":
+                        args.append(lv[d[1]])
+                    elif d[0] == "c":
+                        args.append(consts_v[d[1]])
+                    else:
+                        args.append(env[d[1]][d[2]])
+                o = f(*args)
+                env[i] = tuple(o) if isinstance(o, (tuple, list)) else (o,)
+            return tuple(env[i][j] for (_, i, j) in root_refs_t)
+
+        primals, vjp = jax.vjp(fwd_fn, *lv_outer)
+        # returning the primals too lets the caller rewire root tensors onto
+        # THIS node, so the separately-recorded forward chain goes dead and
+        # XLA sees each forward op exactly once (value_and_grad structure)
+        return tuple(vjp(tuple(cts_v))) + tuple(primals)
+
+    try:
+        outs_all, _ = lazy_mod.record(
+            "tape_backward",
+            tape_bwd,
+            leaf_values + const_inputs + cts,
+            key=("tape", tuple(sig), root_refs_t),
+        )
+    except Exception:
+        return None  # non-traceable replay fn → per-node engine
+    grads_out = outs_all[:nL]
+    primal_out = outs_all[nL:]
+
+    # rewire roots onto the tape primals (frees the fwd chain for DCE when
+    # nothing else holds its intermediates)
+    j = 0
+    for t in roots:
+        if t._grad_node is None:
+            continue
+        if isinstance(t._data, lazy_mod.LazyArray) and t._data._concrete is None:
+            t._data = primal_out[j]
+        j += 1
+
+    # free graphs (match "backward twice" semantics of the per-node engine);
+    # replay tensors are dropped so forward intermediates can die
+    if not retain_graph:
+        for n in nodes:
+            n.vjp_fn = None
+            n.replay = None
+            n.replay_arrays = None
+            n.out_tensors = None
+
+    # leaf accumulation (+ leaf hooks, same semantics as the per-node path)
+    for t, g in zip(diff_leaves, grads_out):
+        hook_g = g
+        for hook in t._backward_hooks:
+            out = hook(Tensor(hook_g) if not isinstance(hook_g, Tensor) else hook_g)
+            if out is not None:
+                hook_g = out._data if isinstance(out, Tensor) else out
+        g_arr = hook_g._data if isinstance(hook_g, Tensor) else hook_g
+        if t.grad is None:
+            t.grad = Tensor(g_arr, stop_gradient=True)
+        else:
+            t.grad._data = lazy_mod.maybe_lazy_binary(
+                jnp.add, t.grad._data, g_arr, name="grad_acc"
+            )
+
+    # leaf roots seed directly
+    for t, g in zip(roots, grad_tensors):
+        if t._grad_node is not None or t.stop_gradient:
+            continue
+        seed = (
+            g._data if isinstance(g, Tensor)
+            else (jnp.asarray(g) if g is not None
+                  else lazy_mod.lazy_full(tuple(t._data.shape), t._data.dtype, 1.0, name="grad_seed"))
+        )
+        if t.grad is None:
+            t.grad = Tensor(seed, stop_gradient=True)
+        else:
+            t.grad._data = lazy_mod.maybe_lazy_binary(jnp.add, t.grad._data, seed, name="grad_acc")
+    return {}
 
 
 # --------------------------------------------------------------------------
@@ -129,6 +358,14 @@ def run_backward(
     captured: dict = {}
     capture = capture or {}
 
+    if not create_graph and not capture and accumulate_leaves:
+        from . import lazy as _lz_mod
+
+        if _lz_mod.lazy_enabled():
+            res = _tape_backward(roots, grad_tensors, retain_graph)
+            if res is not None:
+                return res
+
     if create_graph:
         from .dispatch import eager_call
 
@@ -145,20 +382,23 @@ def run_backward(
                 return g
             return Tensor(jnp.asarray(g, dtype=ref_t._data.dtype))
     else:
+        from . import lazy as lazy_mod
 
         def _acc(dst, g):
             a = g._data if isinstance(g, Tensor) else g
             if dst is None:
                 return a
             d = dst._data if isinstance(dst, Tensor) else dst
-            return jnp.add(d, a)
+            return lazy_mod.maybe_lazy_binary(jnp.add, d, a, name="grad_acc")
 
         def _zeros(shape, dtype):
-            return jnp.zeros(shape, dtype)
+            return lazy_mod.lazy_full(shape, dtype, 0.0, name="grad_zeros")
 
         def _wrap(g, ref_t):
             if isinstance(g, Tensor):
                 return g._data
+            if lazy_mod.is_lazy(g):
+                return g.astype(ref_t._data.dtype)
             return jnp.asarray(g, dtype=ref_t._data.dtype)
 
     # Seed cotangents. pending[node][out_idx] = accumulated cotangent.
@@ -174,13 +414,18 @@ def run_backward(
             captured[id(t)] = _acc(captured.get(id(t)), g)
 
     root_nodes = []
+    from . import lazy as _lz
+
     for t, g in zip(roots, grad_tensors):
         if g is None:
             if t.size != 1:
                 raise RuntimeError(
                     "backward() on a non-scalar tensor requires grad_tensors"
                 )
-            g = _wrap(jnp.ones(t._data.shape, dtype=t._data.dtype), t)
+            seed = _lz.lazy_full(
+                tuple(t._data.shape), t._data.dtype, 1.0, name="grad_seed"
+            ) if not create_graph else jnp.ones(t._data.shape, dtype=t._data.dtype)
+            g = _wrap(seed, t)
         else:
             g = _wrap(g, t)
         node = t._grad_node
@@ -310,6 +555,10 @@ def run_backward(
         if t.grad is None:
             t.grad = Tensor(g_arr, stop_gradient=True)
         else:
-            t.grad._data = jnp.add(t.grad._data, g_arr)
+            from . import lazy as lazy_mod
+
+            t.grad._data = lazy_mod.maybe_lazy_binary(
+                jnp.add, t.grad._data, g_arr, name="grad_acc"
+            )
 
     return captured

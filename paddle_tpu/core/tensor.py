@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from . import dtype as dtypes
 from . import place as place_mod
 from .engine import run_backward, no_grad
+from .lazy import LazyArray
 
 _tensor_count = 0
 
@@ -50,7 +51,7 @@ class Tensor:
         if isinstance(data, Tensor):
             data = data._data
         dt = dtypes.convert_dtype(dtype) if dtype is not None else None
-        if isinstance(data, jax.Array):
+        if isinstance(data, (jax.Array, LazyArray)):
             arr = data if dt is None else data.astype(dt)
         else:
             np_arr = np.asarray(data)

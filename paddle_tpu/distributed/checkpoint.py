@@ -16,16 +16,19 @@ import time
 from typing import Any, Dict, Optional
 
 import numpy as np
+
+from ..core.lazy import concrete as _concrete
 import jax
 
 from ..core.tensor import Tensor
 
 
 def _to_arrays(state: Dict[str, Any]):
+
     out = {}
     for k, v in state.items():
         if isinstance(v, Tensor):
-            out[k] = v._data
+            out[k] = _concrete(v._data)
         elif isinstance(v, dict):
             out[k] = _to_arrays(v)
         else:
@@ -120,7 +123,9 @@ class AutoCheckpoint:
         return os.path.join(self.save_dir, f"step_{step}")
 
     def maybe_save(self, step: int, state_dict: Dict[str, Any]):
-        if step % self.interval:
+        if step == 0 or step % self.interval:
+            # step 0 is the untrained state — saving it would also age out a
+            # useful checkpoint one interval earlier under keep_last
             return False
         if self._pending is not None:
             self._pending.wait_until_finished()
@@ -181,6 +186,11 @@ def engine_state_dict(engine) -> Dict[str, Any]:
     for i, st in enumerate(opt_state["accums"]):
         for k, v in st.items():
             state[f"accum_{i}_{k}"] = Tensor(v, stop_gradient=True)
+    # step count drives Adam/AdamW bias correction (reference checkpoints
+    # beta1_pow/beta2_pow); without it a resume restarts correction at t=1
+    state["opt_step"] = Tensor(
+        np.asarray(engine.optimizer._step_count, np.int64), stop_gradient=True
+    )
     return state
 
 
@@ -190,6 +200,9 @@ def engine_load_state_dict(engine, path) -> None:
     state = engine_state_dict(engine)
     load_state_dict(state, path)
     opt = engine.optimizer
+    step_t = state.get("opt_step")
+    if step_t is not None:
+        opt._step_count = int(np.asarray(step_t._data))
     for i, p in enumerate(engine.params):
         accum = opt._accumulators.get(id(p))
         if accum is None:

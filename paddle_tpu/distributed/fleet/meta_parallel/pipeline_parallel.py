@@ -330,6 +330,12 @@ class PipelineParallelModel(Layer):
 
         inputs, labels = data
 
+        if scaler is not None:
+            raise NotImplementedError(
+                "train_batch does not thread GradScaler loss scaling through the "
+                "compiled pipeline step; train in bf16 (TPU-native, no scaling "
+                "needed) or scale the loss inside the model's loss_fn"
+            )
         if self.num_stages > 1 and not isinstance(self._layers, PipelineLayer):
             raise TypeError(
                 "pp_degree>1 requires the model to be a PipelineLayer; got "

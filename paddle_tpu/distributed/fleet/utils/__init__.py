@@ -24,7 +24,14 @@ def recompute(function, *args, **kwargs):
         return out._data if isinstance(out, Tensor) else tuple(o._data for o in out)
 
     ck = jax.checkpoint(fn)
-    return eager_call("recompute", ck, tensor_args)
+    # jax.checkpoint returns an opaque callable whose identity changes every
+    # call; key on the WRAPPED function so the lazy flush signature is stable
+    # across identical iterations (no per-step recompiles under remat)
+    from ....core.lazy import _fn_key
+
+    return eager_call(
+        "recompute", ck, tensor_args, fn_key=("recompute", _fn_key(function))
+    )
 
 
 class recompute_sequential:

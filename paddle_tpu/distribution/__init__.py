@@ -7,6 +7,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core.lazy import concrete as _concrete
+
 from ..core import random as random_state
 from ..core.tensor import Tensor
 from ..core.dispatch import as_tensor, eager_call
@@ -92,7 +94,7 @@ class Categorical(Distribution):
 
     def sample(self, shape=()):
         key = random_state.next_key()
-        out = jax.random.categorical(key, self.logits._data, shape=tuple(shape) + tuple(self.logits.shape[:-1]))
+        out = jax.random.categorical(key, _concrete(self.logits._data), shape=tuple(shape) + tuple(self.logits.shape[:-1]))
         return Tensor(out.astype(np.int64))
 
     def log_prob(self, value):
@@ -119,7 +121,7 @@ class Bernoulli(Distribution):
     def sample(self, shape=()):
         key = random_state.next_key()
         return Tensor(
-            jax.random.bernoulli(key, self.probs_t._data, tuple(shape) + tuple(self.probs_t.shape)).astype(np.float32)
+            jax.random.bernoulli(key, _concrete(self.probs_t._data), tuple(shape) + tuple(self.probs_t.shape)).astype(np.float32)
         )
 
     def log_prob(self, value):

@@ -24,10 +24,17 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core import lazy as _lazy
 from ..core import random as random_state
 from ..core.engine import GradNode, grad_enabled, no_grad
 from ..core.tensor import Parameter, Tensor
 from ..static.input import InputSpec
+
+
+def _conc(a):
+    """jax.jit arguments must be real buffers: materialize LazyArrays
+    (lazy eager batching) before crossing into a compiled callable."""
+    return _lazy.concrete(a)
 
 
 def _tree_to_arrays(obj):
@@ -109,8 +116,8 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         params, buffers = self._params_buffers()
-        input_arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
-        all_arrays = tuple(p._data for p in params) + tuple(b._data for b in buffers) + tuple(input_arrays)
+        input_arrays = [_conc(a._data) if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        all_arrays = tuple(_conc(p._data) for p in params) + tuple(_conc(b._data) for b in buffers) + tuple(input_arrays)
         key = random_state.next_key()
         shape_key = tuple((tuple(a.shape), str(a.dtype)) for a in all_arrays)
 
@@ -153,9 +160,9 @@ class StaticFunction:
 
         def vjp_fn(cts):
             if single:
-                cts_tree = cts
+                cts_tree = _conc(cts)
             else:
-                cts_tree = tuple(cts)
+                cts_tree = tuple(_conc(c) for c in cts)
             grads = bwd(all_arrays, cts_tree, key)
             return tuple(grads)
 
@@ -184,8 +191,8 @@ class StaticFunction:
     # -- introspection -----------------------------------------------------
     def concrete_program(self, *args):
         params, buffers = self._params_buffers()
-        input_arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
-        all_arrays = tuple(p._data for p in params) + tuple(b._data for b in buffers) + tuple(input_arrays)
+        input_arrays = [_conc(a._data) if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        all_arrays = tuple(_conc(p._data) for p in params) + tuple(_conc(b._data) for b in buffers) + tuple(input_arrays)
         pure = self._pure(len(params), len(buffers))
         return jax.jit(pure).lower(all_arrays, jax.random.PRNGKey(0))
 
@@ -279,8 +286,8 @@ class CompiledTrainStep:
     def __call__(self, *batch):
         if self._jit is None:
             self._build()
-        batch_arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch)
-        param_arrays = [p._data for p in self.params]
+        batch_arrays = tuple(_conc(b._data) if isinstance(b, Tensor) else jnp.asarray(b) for b in batch)
+        param_arrays = [_conc(p._data) for p in self.params]
         opt_state = self.optimizer._functional_state(self.params)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = random_state.next_key()
@@ -407,7 +414,7 @@ class TranslatedLayer:
         self.training = False
 
     def __call__(self, *args):
-        arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        arrays = [_conc(a._data) if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
         outs = self._exported.call(*arrays)
         return _tree_to_tensors(outs)
 

@@ -49,6 +49,8 @@ class GPTConfig:
     attention_dropout: float = 0.1
     initializer_range: float = 0.02
     use_mp_layers: bool = True  # Megatron-shardable weights (GSPMD specs)
+    fused_lm_loss: bool = True  # blockwise head+CE, no (B·T,V) logits tensor
+    remat: bool = False  # jax.checkpoint each decoder layer (1.3B-on-a-chip)
     sequence_parallel: bool = False  # annotate activations with 'sp'
     # "auto": ring attention whenever sequence_parallel and the mesh has an
     # 'sp' axis >1 (the long-context path — O(T/sp) memory per device, K/V
@@ -217,8 +219,23 @@ class GPTModel(nn.Layer):
 
     def forward(self, input_ids, position_ids=None, attn_mask=None):
         x = self.embeddings(input_ids, position_ids)
-        for layer in self.layers:
-            x = layer(x, attn_mask)
+        if self.config.remat:
+            # activation checkpointing: drop per-layer residuals, XLA
+            # rematerializes them in the backward (HBM for FLOPs — the
+            # single-chip 1.3B training config needs this)
+            from ..distributed.fleet.utils import recompute
+
+            for layer in self.layers:
+                if attn_mask is None:
+                    x = recompute(lambda h, _l=layer: _l(h, None), x)
+                else:
+                    # mask travels as a tensor ARG (a closed-over tensor would
+                    # change the flush-cache key every step and a pending
+                    # LazyArray cannot cross the jax.checkpoint boundary)
+                    x = recompute(lambda h, m, _l=layer: _l(h, m), x, attn_mask)
+        else:
+            for layer in self.layers:
+                x = layer(x, attn_mask)
         return self.final_ln(x)
 
 
@@ -237,6 +254,13 @@ class GPTForPretraining(nn.Layer):
         return logits
 
     def loss(self, input_ids, labels):
+        if getattr(self.config, "fused_lm_loss", True):
+            # blockwise fused projection+CE: never materializes the
+            # (B·T, vocab) fp32 logits (ops/fused_ce.py) — this is what
+            # bounds trainable batch size at V≈50k
+            x = self.gpt(input_ids)
+            w = self.gpt.embeddings.word_embeddings.weight
+            return F.fused_linear_cross_entropy(x, w, labels)
         logits = self(input_ids)
         return F.cross_entropy(
             logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1])

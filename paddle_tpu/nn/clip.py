@@ -1,13 +1,21 @@
 """Gradient clipping (reference python/paddle/fluid/clip.py).
 
 ClipGradByGlobalNorm matches the reference semantics: one global norm across
-all grads, scale applied uniformly — a single fused XLA computation.
+all grads, scale applied uniformly. Each clip is routed through eager_call as
+a single variadic op, so in lazy mode it fuses into the same flushed XLA
+computation as backward + optimizer update, and under per-op dispatch it is
+one jitted executable.
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..core.dispatch import eager_call
 from ..core.tensor import Tensor
+
+
+def _as_list(out):
+    return out if isinstance(out, (list, tuple)) else [out]
 
 
 class ClipGradBase:
@@ -26,7 +34,14 @@ class ClipGradByValue(ClipGradBase):
             if g is None or (hasattr(p, "need_clip") and not p.need_clip):
                 out.append((p, g))
                 continue
-            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+            clipped = eager_call(
+                "clip_by_value",
+                lambda a, lo=0.0, hi=0.0: jnp.clip(a, lo, hi),
+                [g],
+                attrs={"lo": self.min, "hi": self.max},
+                differentiable=False,
+            )
+            out.append((p, clipped))
         return out
 
 
@@ -40,9 +55,14 @@ class ClipGradByNorm(ClipGradBase):
             if g is None or (hasattr(p, "need_clip") and not p.need_clip):
                 out.append((p, g))
                 continue
-            norm = jnp.sqrt(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
-            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
-            out.append((p, Tensor((g._data.astype(jnp.float32) * scale).astype(g._data.dtype))))
+
+            def fn(a, clip_norm=self.clip_norm):
+                af = a.astype(jnp.float32)
+                norm = jnp.sqrt(jnp.sum(jnp.square(af)))
+                scale = jnp.minimum(clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+                return (af * scale).astype(a.dtype)
+
+            out.append((p, eager_call("clip_by_norm", fn, [g], differentiable=False)))
         return out
 
 
@@ -51,21 +71,31 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
 
     def __call__(self, params_grads):
-        sq = [
-            jnp.sum(jnp.square(g._data.astype(jnp.float32)))
-            for p, g in params_grads
+        idx = [
+            i
+            for i, (p, g) in enumerate(params_grads)
             if g is not None and getattr(p, "need_clip", True)
         ]
-        if not sq:
+        if not idx:
             return params_grads
-        global_norm = jnp.sqrt(sum(sq))
-        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
-        out = []
-        for p, g in params_grads:
-            if g is None or not getattr(p, "need_clip", True):
-                out.append((p, g))
-            else:
-                out.append((p, Tensor((g._data.astype(jnp.float32) * scale).astype(g._data.dtype))))
+
+        def fn(*gs, clip_norm=self.clip_norm):
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gs)
+            gn = jnp.sqrt(sq)
+            scale = clip_norm / jnp.maximum(gn, clip_norm)
+            return tuple((g.astype(jnp.float32) * scale).astype(g.dtype) for g in gs)
+
+        clipped = _as_list(
+            eager_call(
+                "global_norm_clip",
+                fn,
+                [params_grads[i][1] for i in idx],
+                differentiable=False,
+            )
+        )
+        out = list(params_grads)
+        for j, i in enumerate(idx):
+            out[i] = (params_grads[i][0], clipped[j])
         return out
 
 
@@ -73,14 +103,26 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
     params = [p for p in parameters if p.grad is not None]
     if not params:
         return Tensor(jnp.zeros(()))
-    if norm_type == float("inf"):
-        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._data)) for p in params]))
-    else:
-        total = jnp.power(
-            sum(jnp.sum(jnp.power(jnp.abs(p.grad._data.astype(jnp.float32)), norm_type)) for p in params),
-            1.0 / norm_type,
+
+    def fn(*gs, max_norm=float(max_norm), norm_type=float(norm_type)):
+        if norm_type == float("inf"):
+            total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in gs]))
+        else:
+            total = jnp.power(
+                sum(
+                    jnp.sum(jnp.power(jnp.abs(g.astype(jnp.float32)), norm_type))
+                    for g in gs
+                ),
+                1.0 / norm_type,
+            )
+        scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+        return (total,) + tuple(
+            (g.astype(jnp.float32) * scale).astype(g.dtype) for g in gs
         )
-    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
-    for p in params:
-        p.grad._set_data((p.grad._data.astype(jnp.float32) * scale).astype(p.grad._data.dtype))
-    return Tensor(total)
+
+    outs = _as_list(
+        eager_call("clip_grad_norm_", fn, [p.grad for p in params], differentiable=False)
+    )
+    for p, t in zip(params, outs[1:]):
+        p.grad._set_data(t._data)
+    return outs[0]

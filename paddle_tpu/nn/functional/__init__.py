@@ -40,4 +40,5 @@ from .vision import (  # noqa: F401
 )
 from .activation import relu_, elu_, softmax_  # noqa: F401
 from .loss import hsigmoid_loss, margin_cross_entropy  # noqa: F401
+from .loss import fused_linear_cross_entropy  # noqa: F401
 from .common import class_center_sample  # noqa: F401

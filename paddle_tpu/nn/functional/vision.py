@@ -54,12 +54,15 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=Tr
             fy = ((gy + 1) * H - 1) / 2
 
         if padding_mode == "reflection":
-            # reflect sample coordinates back into the image (reference
-            # grid_sampler reflection padding), then clamp residual drift
+            # reflect sample coordinates back into the image. Reference
+            # grid_sampler reflects about [0, n-1] when align_corners=True
+            # but about the pixel-edge extent [-0.5, n-0.5] when False.
             def refl(c, n):
-                span = jnp.maximum(n - 1, 1)
-                c = jnp.abs(c) % (2 * span)
-                return jnp.where(c > span, 2 * span - c, c)
+                lo = 0.0 if align else -0.5
+                hi = (n - 1.0) if align else (n - 0.5)
+                span = max(hi - lo, 1e-3)
+                c = jnp.abs(c - lo) % (2 * span)
+                return lo + jnp.where(c > span, 2 * span - c, c)
 
             fx = refl(fx, W)
             fy = refl(fy, H)

@@ -4,6 +4,8 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+from ...core.lazy import concrete as _concrete
+
 from ...core.tensor import Tensor
 
 
@@ -26,7 +28,7 @@ def weight_norm(layer, name="weight", dim=0):
 
     w = getattr(layer, name)
     axes = tuple(i for i in range(w.ndim) if i != dim)
-    norm = jnp.sqrt(jnp.sum(jnp.square(w._data), axis=axes, keepdims=True))
+    norm = jnp.sqrt(jnp.sum(jnp.square(_concrete(w._data)), axis=axes, keepdims=True))
     g = layer.create_parameter(list(norm.shape), default_initializer=lambda s, d: norm)
     v = layer.create_parameter(list(w.shape), default_initializer=lambda s, d: w._data)
     layer.add_parameter(name + "_g", g)
@@ -53,7 +55,7 @@ def remove_weight_norm(layer, name="weight"):
     v = layer._parameters.pop(name + "_v", None)
     if g is not None and v is not None:
         axes = tuple(i for i in range(v.ndim) if i != 0)
-        n = jnp.sqrt(jnp.sum(jnp.square(v._data), axis=axes, keepdims=True))
+        n = jnp.sqrt(jnp.sum(jnp.square(_concrete(v._data)), axis=axes, keepdims=True))
         w = layer.create_parameter(list(v.shape), default_initializer=lambda s, d: g._data * v._data / n)
         layer.add_parameter(name, w)
         object.__setattr__(layer, name, w)

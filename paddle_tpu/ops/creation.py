@@ -11,6 +11,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core.lazy import concrete as _concrete
+
 from ..core import dtype as dtypes
 from ..core import random as random_state
 from ..core.tensor import Tensor
@@ -53,17 +55,17 @@ def empty(shape, dtype=None, name=None):
 
 def zeros_like(x, dtype=None, name=None):
     x = as_tensor(x)
-    return Tensor(jnp.zeros_like(x._data, dtype=_dt(dtype, x.dtype)))
+    return Tensor(jnp.zeros(tuple(x._data.shape), dtype=_dt(dtype, x.dtype) or x._data.dtype))
 
 
 def ones_like(x, dtype=None, name=None):
     x = as_tensor(x)
-    return Tensor(jnp.ones_like(x._data, dtype=_dt(dtype, x.dtype)))
+    return Tensor(jnp.ones(tuple(x._data.shape), dtype=_dt(dtype, x.dtype) or x._data.dtype))
 
 
 def full_like(x, fill_value, dtype=None, name=None):
     x = as_tensor(x)
-    return Tensor(jnp.full_like(x._data, fill_value, dtype=_dt(dtype, x.dtype)))
+    return Tensor(jnp.full(tuple(x._data.shape), fill_value, dtype=_dt(dtype, x.dtype) or x._data.dtype))
 
 
 def empty_like(x, dtype=None, name=None):
@@ -128,7 +130,7 @@ def triu(x, diagonal=0, name=None):
 
 def meshgrid(*args, name=None):
     tensors = [as_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
-    outs = jnp.meshgrid(*[t._data for t in tensors], indexing="ij")
+    outs = jnp.meshgrid(*[_concrete(t._data) for t in tensors], indexing="ij")
     return [Tensor(o) for o in outs]
 
 
@@ -223,12 +225,13 @@ def randperm(n, dtype=None, name=None):
 def bernoulli(x, name=None):
     x = as_tensor(x)
     key = random_state.next_key()
-    return Tensor(jax.random.bernoulli(key, x._data.astype(jnp.float32)).astype(x.dtype))
+    return Tensor(jax.random.bernoulli(key, _concrete(x._data).astype(jnp.float32)).astype(x.dtype))
 
 
 def multinomial(x, num_samples=1, replacement=False, name=None):
     x = as_tensor(x)
     key = random_state.next_key()
+    x = Tensor(_concrete(x._data), stop_gradient=x.stop_gradient)
     logits = jnp.log(jnp.maximum(x._data.astype(jnp.float32), 1e-30))
     if x.ndim == 1:
         out = jax.random.choice(

@@ -1,0 +1,95 @@
+"""Fused LM-head cross-entropy (blockwise logits→CE, no full-logits tensor).
+
+Role parity: reference ``operators/collective/c_softmax_with_cross_entropy``
++ the fused softmax/CE kernels (``operators/math/`` softmax impls) — the
+reason those exist is exactly this memory wall: a (B·T, V) fp32 logits
+tensor for V≈50k bounds the trainable batch. TPU-first design: a
+``lax.scan`` over row blocks computes ``x_block @ W^T`` on the MXU
+(bf16 in, f32 accumulate), reduces each block to its logsumexp + label
+logit, and discards the block logits — peak extra memory is
+``block_rows × V`` fp32 instead of ``B·T × V``. The custom VJP recomputes
+block logits in the backward (rematerialization: FLOPs are cheap, HBM is
+not) and streams ``dW`` accumulation in fp32.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block(x, labels, block_rows):
+    N, d = x.shape
+    nb = -(-N // block_rows)
+    pad = nb * block_rows - N
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=-1)
+    return x.reshape(nb, block_rows, d), labels.reshape(nb, block_rows)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy(x, w, labels, block_rows=2048, ignore_index=-100):
+    """mean over valid rows of CE(softmax(x @ w.T), labels).
+
+    x: (N, d); w: (V, d) — the (tied) LM-head/embedding weight; labels: (N,)
+    int. Rows where ``labels == ignore_index`` (or padding) are excluded
+    from both the sum and the mean denominator.
+    """
+    loss, _ = _fce_fwd(x, w, labels, block_rows, ignore_index)
+    return loss
+
+
+def _fce_fwd(x, w, labels, block_rows, ignore_index):
+    xb, lb = _block(x, labels, block_rows)
+    V = w.shape[0]
+
+    def body(carry, blk):
+        xs, ls = blk
+        logits = jnp.dot(xs, w.T, preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        li = jnp.clip(ls, 0, V - 1)
+        corr = jnp.take_along_axis(logits, li[:, None], axis=-1)[:, 0]
+        valid = (ls != ignore_index) & (ls >= 0)
+        nll = jnp.where(valid, lse - corr, 0.0)
+        s, c = carry
+        return (s + nll.sum(), c + valid.sum(dtype=jnp.int32)), None
+
+    (total, cnt), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (xb, lb)
+    )
+    loss = total / jnp.maximum(cnt, 1).astype(jnp.float32)
+    return loss, (x, w, labels)
+
+
+def _fce_bwd(block_rows, ignore_index, res, ct):
+    x, w, labels = res
+    xb, lb = _block(x, labels, block_rows)
+    V, d = w.shape
+    valid_all = (labels != ignore_index) & (labels >= 0)
+    n_valid = jnp.maximum(valid_all.sum(), 1).astype(jnp.float32)
+    scale = (ct / n_valid).astype(jnp.float32)
+
+    def body(dw, blk):
+        xs, ls = blk
+        logits = jnp.dot(xs, w.T, preferred_element_type=jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        li = jnp.clip(ls, 0, V - 1)
+        valid = (ls != ignore_index) & (ls >= 0)
+        g = p - jax.nn.one_hot(li, V, dtype=p.dtype)
+        g = g * (valid.astype(p.dtype) * scale)[:, None]
+        gb = g.astype(w.dtype)
+        dx_blk = jnp.dot(gb, w, preferred_element_type=jnp.float32).astype(x.dtype)
+        dw_blk = jnp.dot(gb.T, xs, preferred_element_type=jnp.float32)
+        return dw + dw_blk, dx_blk
+
+    dw, dxb = lax.scan(body, jnp.zeros((V, d), jnp.float32), (xb, lb))
+    dx = dxb.reshape(-1, d)[: x.shape[0]].astype(x.dtype)
+    dlabels = np.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return dx, dw.astype(w.dtype), dlabels
+
+
+fused_linear_cross_entropy.defvjp(_fce_fwd, _fce_bwd)

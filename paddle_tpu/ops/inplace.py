@@ -92,7 +92,7 @@ def fill_(self, value):
 
     if not self.stop_gradient and grad_enabled():
         raise RuntimeError("fill_(): in-place on a tensor that requires grad")
-    self._set_data(jnp.full_like(self._data, value))
+    self._set_data(jnp.full(tuple(self._data.shape), value, dtype=self._data.dtype))
     return self
 
 

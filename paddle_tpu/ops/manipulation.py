@@ -10,6 +10,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core.lazy import concrete as _concrete
+
 from ..core import dtype as dtypes
 from ..core.tensor import Tensor
 from ..core.dispatch import as_tensor, eager_call
@@ -705,7 +707,8 @@ def atleast_3d(*inputs):
 
 def as_real(x, name=None):
     x = as_tensor(x)
-    return Tensor(jnp.stack([jnp.real(x._data), jnp.imag(x._data)], axis=-1))
+    xa = _concrete(x._data)
+    return Tensor(jnp.stack([jnp.real(xa), jnp.imag(xa)], axis=-1))
 
 
 def as_complex(x, name=None):

@@ -11,6 +11,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core.lazy import concrete as _concrete
+
 from ..core import dtype as dtypes
 from ..core.tensor import Tensor
 from ..core.dispatch import as_tensor, eager_call
@@ -190,12 +192,12 @@ bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
 
 def equal_all(x, y, name=None):
     x, y = as_tensor(x), as_tensor(y)
-    return Tensor(jnp.array_equal(x._data, y._data))
+    return Tensor(jnp.array_equal(_concrete(x._data), _concrete(y._data)))
 
 
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
     x, y = as_tensor(x), as_tensor(y)
-    return Tensor(jnp.allclose(x._data, y._data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+    return Tensor(jnp.allclose(_concrete(x._data), _concrete(y._data), rtol=rtol, atol=atol, equal_nan=equal_nan))
 
 
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
@@ -524,5 +526,5 @@ def increment(x, value=1.0, name=None):
 
 def accuracy_tensor(pred, label):  # helper used by metric
     pred, label = as_tensor(pred), as_tensor(label)
-    correct = jnp.equal(jnp.argmax(pred._data, axis=-1), label._data.reshape(-1))
+    correct = jnp.equal(jnp.argmax(_concrete(pred._data), axis=-1), _concrete(label._data).reshape(-1))
     return Tensor(jnp.mean(correct.astype(jnp.float32)))

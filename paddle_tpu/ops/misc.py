@@ -10,6 +10,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core.lazy import concrete as _concrete
+
 from ..core.dispatch import as_tensor, eager_call
 from ..core.tensor import Tensor
 
@@ -110,7 +112,7 @@ def poisson(x, name=None):
     t = as_tensor(x)
     key = random_state.next_key()
     return Tensor(
-        jax.random.poisson(key, t._data.astype(jnp.float32)).astype(t._data.dtype),
+        jax.random.poisson(key, _concrete(t._data).astype(jnp.float32)).astype(t._data.dtype),
         stop_gradient=True,
     )
 

@@ -10,6 +10,8 @@ single executable.
 """
 from __future__ import annotations
 
+import itertools
+import weakref
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -32,6 +34,9 @@ class L1Decay:
         self.coeff = float(coeff)
 
 
+_optimizer_uid = itertools.count()
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None, **kwargs):
         self._parameter_list = list(parameters) if parameters is not None else None
@@ -44,6 +49,7 @@ class Optimizer:
         self._accumulators: Dict[int, dict] = {}
         self._step_count = 0
         self._jitted_rule = None
+        self._uid = next(_optimizer_uid)  # lazy-flush cache key (id() can be reused)
 
     # -- lr ---------------------------------------------------------------
     def get_lr(self):
@@ -116,6 +122,10 @@ class Optimizer:
     @no_grad()
     def step(self):
         self._step_count += 1
+        from ..core import lazy as lazy_mod
+
+        if lazy_mod.lazy_enabled():
+            return self._lazy_step()
         lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
         t = jnp.asarray(float(self._step_count), dtype=jnp.float32)
         if self._jitted_rule is None:
@@ -136,6 +146,54 @@ class Optimizer:
             st.update(new_st)
             p._set_data(new_p)
 
+    def _lazy_step(self):
+        """Record the update rule into the lazy graph per parameter, so the
+        whole optimizer step fuses into the same flushed XLA computation as
+        the backward pass (one executable per train iteration)."""
+        from ..core import lazy as lazy_mod
+
+        lr = np.float32(self.get_lr())
+        t = np.float32(self._step_count)
+        for p, grad in self._collect():
+            g = grad._data if isinstance(grad, Tensor) else grad
+            st = self._state(p)
+            if not st:
+                # first step: params are still concrete (freshly initialized)
+                st.update(self._init_accums(
+                    jax.ShapeDtypeStruct(tuple(p._data.shape), p._data.dtype)
+                ))
+            keys = tuple(sorted(st))
+            wd = float(self._wd_on(p))
+            plr = float(p.optimize_attr.get("learning_rate", 1.0)) if hasattr(p, "optimize_attr") else 1.0
+            # close over a WEAKREF: the flush-executable cache retains node
+            # fns, and a strong `self` here would pin the whole optimizer
+            # (params + moments) long after the user discards it
+            wself = weakref.ref(self)
+
+            def rule_flat(p_a, g_a, lr_a, t_a, *stv, _keys=keys, _wd=wd, _s=plr):
+                opt_ = wself()
+                if g_a.dtype != p_a.dtype:
+                    g_a = g_a.astype(p_a.dtype)
+                g_a = opt_._regularize_arr(p_a, g_a)
+                new_p, new_st = opt_._rule(
+                    p_a, g_a, dict(zip(_keys, stv)), lr_a * _s, t_a, _wd
+                )
+                return (new_p,) + tuple(new_st[k] for k in _keys)
+
+            outs, _ = lazy_mod.record(
+                "opt_" + type(self).__name__,
+                rule_flat,
+                [p._data, g, lr, t] + [st[k] for k in keys],
+                key=("opt", type(self).__name__, self._uid, keys, wd, plr),
+            )
+            p._set_data(outs[0])
+            for k, v in zip(keys, outs[1:]):
+                st[k] = v
+        # step boundary: flush now so every train iteration is ONE stable
+        # graph signature ([fwd+bwd+opt]) that hits the executable cache,
+        # instead of an ever-growing pending graph compiled once per flush
+        lazy_mod.flush()
+
     def clear_grad(self, set_to_zero=True):
         for p in self._parameter_list or []:
             p.clear_grad()
@@ -149,12 +207,16 @@ class Optimizer:
 
     # -- functional (fused-train-step) API ---------------------------------
     def _functional_state(self, params):
+        from ..core import lazy as lazy_mod
+
         accums = []
         for p in params:
             st = self._state(p)
             if not st:
-                st.update(self._init_accums(p._data))
-            accums.append(dict(st))
+                st.update(self._init_accums(lazy_mod.concrete(p._data)))
+            # materialize: jit callers (CompiledTrainStep/engines) require
+            # real buffers, and eager lazy steps store LazyArrays here
+            accums.append({k: lazy_mod.concrete(v) for k, v in st.items()})
         return {"t": jnp.asarray(float(self._step_count + 1), jnp.float32), "accums": accums}
 
     def _functional_update(self, param_arrays, grads, state, lr, params=None):

@@ -13,6 +13,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core.lazy import concrete as _concrete
+
 from ..core.dispatch import as_tensor, eager_call
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
@@ -86,8 +88,19 @@ class FakeQuantMovingAverageAbsMax(Layer):
 
     def forward(self, x):
         t = as_tensor(x)
+        if self.training and isinstance(t._data, jax.core.Tracer):
+            # compiled train path: the activation is a tracer, so the EMA
+            # buffer cannot be updated host-side. Quantize with an in-graph
+            # per-batch abs-max scale instead; the persistent EMA state only
+            # advances on eager steps.
+            def fn_traced(a):
+                scale = jnp.maximum(jnp.max(jnp.abs(a)), 1e-8).astype(a.dtype)
+                return _fake_quant_ste(a, jax.lax.stop_gradient(scale))
+
+            return eager_call("fq_act_batch_absmax", fn_traced, [t])
         if self.training:
-            cur = float(jnp.max(jnp.abs(t._data)))
+
+            cur = float(jnp.max(jnp.abs(_concrete(t._data))))
             prev = float(np.asarray(self.scale._data))
             new = cur if not self._initialized else self._rate * prev + (1 - self._rate) * cur
             self._initialized = True
@@ -165,7 +178,8 @@ class PostTrainingQuantization:
 
     def _collect(self, layer_name):
         def hook(layer, inputs, output):
-            arr = output._data if isinstance(output, Tensor) else output
+
+            arr = _concrete(output._data if isinstance(output, Tensor) else output)
             cur = float(jnp.max(jnp.abs(arr)))
             if self.algo == "avg":
                 prev = self.act_scales.get(layer_name)

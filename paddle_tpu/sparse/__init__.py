@@ -15,6 +15,8 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from ..core.lazy import concrete as _concrete
 from jax.experimental import sparse as jsparse
 
 from ..core.dispatch import as_tensor
@@ -219,7 +221,7 @@ def masked_matmul(x, y, mask, name=None):
     coo = m.to_bcoo() if isinstance(m, jsparse.BCSR) else m
     rows = coo.indices[:, 0]
     cols = coo.indices[:, 1]
-    vals = jnp.einsum("nk,nk->n", xt._data[rows], jnp.swapaxes(yt._data, 0, 1)[cols])
+    vals = jnp.einsum("nk,nk->n", _concrete(xt._data)[rows], jnp.swapaxes(_concrete(yt._data), 0, 1)[cols])
     return _rewrap(jsparse.BCOO((vals, coo.indices), shape=coo.shape), mask)
 
 
