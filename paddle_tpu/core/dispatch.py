@@ -39,6 +39,8 @@ def _hashable(v):
         return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
     if isinstance(v, np.dtype):
         return str(v)
+    if isinstance(v, (bool, int, float, complex)):
+        return lazy_mod._typed(v)  # 1/1.0/True hash-collide but trace differently
     return v
 
 

@@ -291,16 +291,29 @@ def _probe(key, fn, in_avals):
     return res
 
 
+def _typed(v):
+    """Tag scalars with their type: 1, 1.0 and True are == and hash-equal in
+    Python, but produce different traced programs (int64 vs float64 vs bool
+    constants) — an untyped key silently serves the wrong executable."""
+    if isinstance(v, (bool, int, float, complex)):
+        return (type(v).__name__, v)
+    if isinstance(v, tuple):
+        return tuple(_typed(x) for x in v)
+    return v
+
+
 def _fn_key(fn):
     """Stable identity for a function: code object + closure/default VALUES.
     Shared by dispatch.py (per-op jit cache) and this module (flush
     signature); keyword-only defaults are part of the key."""
     try:
         cells = tuple(
-            c.cell_contents for c in (getattr(fn, "__closure__", None) or ())
+            _typed(c.cell_contents) for c in (getattr(fn, "__closure__", None) or ())
         )
-        defaults = getattr(fn, "__defaults__", None) or ()
-        kwdefaults = tuple(sorted((getattr(fn, "__kwdefaults__", None) or {}).items()))
+        defaults = tuple(_typed(v) for v in (getattr(fn, "__defaults__", None) or ()))
+        kwdefaults = tuple(
+            sorted((k, _typed(v)) for k, v in (getattr(fn, "__kwdefaults__", None) or {}).items())
+        )
         code = getattr(fn, "__code__", None)
         key = (code, cells, defaults, kwdefaults) if code is not None else fn
         hash(key)

@@ -128,6 +128,14 @@ class Tensor:
             raise ValueError(
                 "The truth value of a Tensor with more than one element is ambiguous"
             )
+        if isinstance(self._data, jax.core.Tracer):
+            raise TypeError(
+                "bool() on a traced Tensor: data-dependent Python control flow "
+                "inside jit/to_static needs conversion — use tensor-assigning "
+                "`if`/`while` bodies (converted to lax.cond/while_loop by "
+                "to_static) or paddle.static.nn.cond/while_loop; `return` "
+                "inside a tensor-dependent branch is not convertible"
+            )
         return bool(self.item())
 
     def __len__(self):

@@ -100,15 +100,32 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    scale = 1.0 / math.sqrt(qg.shape[-1])
+    o = _local_attention(qg, kg, vg, causal)
+    return heads_to_seq(o).astype(q.dtype)
+
+
+def _local_attention(qg, kg, vg, causal):
+    """Full-sequence local attention for the Ulysses inner step. Routes to
+    the Pallas flash kernel (blockwise online softmax — peak memory ∝
+    T·block instead of T², which is the entire point of the long-context
+    path); falls back to the einsum formulation only when the head dim
+    can't tile (D>256 or D%8)."""
+    d = qg.shape[-1]
+    if d <= 256 and d % 8 == 0:
+        try:
+            from ....ops.pallas.flash_attention import flash_attention_array
+        except ImportError:
+            flash_attention_array = None
+        if flash_attention_array is not None:
+            return flash_attention_array(qg, kg, vg, causal=causal)
+    scale = 1.0 / math.sqrt(d)
     s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg) * scale
     if causal:
         T = s.shape[-1]
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, vg)
-    return heads_to_seq(o).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, vg)
 
 
 def split_sequence(x, axis_name="sp", seq_axis=1):

@@ -270,6 +270,113 @@ def _tree_to_tensor(obj):
     return obj
 
 
+def _numpy_collate(batch):
+    """Worker-side collate: numpy end to end — forked children must never
+    touch the inherited JAX/PJRT client (reference workers are CPU-only for
+    the same reason: dataloader_iter.py worker processes build LoDTensors
+    from numpy, never CUDA)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._data) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        return [_numpy_collate(list(s)) for s in zip(*batch)]
+    if isinstance(sample, dict):
+        return {k: _numpy_collate([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def _mp_worker_loop(dataset, collate_fn, index_q, data_q):
+    """Forked worker process: indices in, pickled numpy batches out
+    (reference ``fluid/dataloader/dataloader_iter.py:326`` worker loop +
+    ``worker.py`` — same protocol, minus the shared-memory LoDTensor
+    transport which multiprocessing pipes replace here)."""
+    import traceback
+
+    while True:
+        item = index_q.get()
+        if item is None:
+            return
+        i, indices = item
+        try:
+            batch = collate_fn([dataset[j] for j in indices])
+            data_q.put((i, "ok", batch))
+        except Exception:
+            data_q.put((i, "err", traceback.format_exc()))
+
+
+class _MultiprocessIter:
+    """num_workers forked processes → mp.Queue → ordered reassembly →
+    tensorize on the consumer (reference _DataLoaderIterMultiProcess:
+    out-of-order completions are buffered until their turn)."""
+
+    def __init__(self, loader):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        self.loader = loader
+        collate = loader.collate_fn or _numpy_collate
+        self.index_q = ctx.Queue()
+        self.data_q = ctx.Queue()
+        self.n_batches = 0
+        for i, indices in enumerate(iter(loader.batch_sampler)):
+            self.index_q.put((i, list(indices)))
+            self.n_batches = i + 1
+        for _ in range(loader.num_workers):
+            self.index_q.put(None)
+        self.workers = [
+            ctx.Process(
+                target=_mp_worker_loop,
+                args=(loader.dataset, collate, self.index_q, self.data_q),
+                daemon=True,
+            )
+            for _ in range(loader.num_workers)
+        ]
+        for w in self.workers:
+            w.start()
+        self._next = 0
+        self._hold = {}
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next >= self.n_batches:
+            self._shutdown()
+            raise StopIteration
+        while self._next not in self._hold:
+            i, kind, payload = self.data_q.get()
+            self._hold[i] = (kind, payload)
+        kind, payload = self._hold.pop(self._next)
+        self._next += 1
+        if kind == "err":
+            self._shutdown()
+            raise RuntimeError(f"DataLoader worker failed:\n{payload}")
+        batch = _tree_to_tensor(payload)
+        if self.loader.return_list and isinstance(batch, (list, tuple)):
+            return list(batch)
+        return batch
+
+    def _shutdown(self):
+        for w in self.workers:
+            if w.is_alive():
+                w.terminate()
+        for w in self.workers:
+            w.join(timeout=1.0)
+        self.workers = []
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
 class _DataLoaderIter:
     """Worker threads → bounded queue → host→device transfer.
 
@@ -417,6 +524,9 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
+        # worker PROCESSES (reference default: GIL-free preprocessing via
+        # dataloader_iter.py:326 fork+shared-memory); False → thread workers
+        self.use_multiprocess = use_shared_memory
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
@@ -432,6 +542,11 @@ class DataLoader:
     def __iter__(self):
         if isinstance(self.dataset, IterableDataset):
             return _IterableIter(self)
+        if self.num_workers > 0 and self.use_multiprocess:
+            import multiprocessing as mp
+
+            if "fork" in mp.get_all_start_methods():
+                return _MultiprocessIter(self)
         return _DataLoaderIter(self)
 
     def __len__(self):

@@ -66,12 +66,29 @@ class StaticFunction:
     """
 
     def __init__(self, function, layer=None, input_spec=None):
-        self._fn = function
+        self._fn = self._convert_control_flow(function)
         self._layer = layer
         self._input_spec = input_spec
         self._fwd_cache = {}
         self._bwd_cache = {}
         self._last_lowered = None
+
+    @staticmethod
+    def _convert_control_flow(function):
+        """AST-convert tensor-dependent Python if/while into lax control flow
+        (dy2static.py; reference program_translator.py:775). Functions whose
+        source can't be rewritten keep trace-only capture."""
+        import types as _types
+
+        from . import dy2static
+
+        raw = getattr(function, "__func__", function)
+        transformed = dy2static.transform_function(raw)
+        if transformed is None:
+            return function
+        if hasattr(function, "__self__"):
+            return _types.MethodType(transformed, function.__self__)
+        return transformed
 
     def program(self, *example_inputs):
         """Program view of the traced computation (reference

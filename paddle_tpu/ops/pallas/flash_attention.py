@@ -41,8 +41,10 @@ def _prec(dtype):
     )
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float, t_kv: int):
-    # q_ref: (1, BQ, D); k_ref/v_ref: (1, T, D); o_ref: (1, BQ, D); lse_ref: (1, BQ, 1)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float, t_kv: int, kv_len: int):
+    # q_ref: (1, BQ, D); k_ref/v_ref: (1, T, D); o_ref: (1, BQ, D); lse_ref: (1, 1, BQ)
+    # lse/delta ride the LANE axis: a (T, 1) single-lane VMEM block crashes
+    # the Mosaic compiler at T=8192 (one f32 per 8x128 tile); (1, T) tiles fine
     iq = pl.program_id(1)
     bq = q_ref.shape[1]
     d = q_ref.shape[2]
@@ -62,10 +64,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
         ) * jnp.float32(scale)  # (BQ, BK) f32 accum
-        if causal:
+        if causal or kv_len < t_kv:
             q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, jnp.float32(_NEG_INF))
+            valid = k_pos < kv_len  # zero-padded keys must not attend
+            if causal:
+                valid = valid & (q_pos >= k_pos)
+            s = jnp.where(valid, s, jnp.float32(_NEG_INF))
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new[:, None])
@@ -86,24 +91,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
     m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, jnp.float32(1e-30))
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, :, 0] = m + jnp.log(l_safe)
+    lse_ref[0, 0, :] = m + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len):
     # q: (BH, T, D). Traced with x64 disabled: the framework enables x64
     # globally (paddle int64 semantics) but Mosaic has no i64/f64 lowering —
     # index maps and weak python scalars must stay 32-bit inside the kernel.
     with jax.enable_x64(False):
-        return _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret)
+        return _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len)
 
 
-def _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len):
     bh, t, d = q.shape
     t_kv = k.shape[1]
     scale = 1.0 / math.sqrt(d)
     grid = (bh, t // block_q)
     kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, causal=causal, scale=scale, t_kv=t_kv
+        _fwd_kernel, block_k=block_k, causal=causal, scale=scale, t_kv=t_kv,
+        kv_len=kv_len,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -115,37 +121,37 @@ def _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, kv_len):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
     return out, (q, k, v, out, lse)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k: int, causal: bool, scale: float, t_kv: int):
-    # q/do/dq: (1, BQ, D); k/v: (1, T, D); lse/delta: (1, BQ, 1)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k: int, causal: bool, scale: float, t_kv: int, kv_len: int):
+    # q/do/dq: (1, BQ, D); k/v: (1, T, D); lse/delta: (1, 1, BQ)
     iq = pl.program_id(1)
     bq = q_ref.shape[1]
     q = q_ref[0]  # (BQ, D)
     _PREC = _prec(q.dtype)
     do = do_ref[0]
-    lse = lse_ref[0, :, 0]
-    delta = delta_ref[0, :, 0]
+    lse = lse_ref[0, 0, :]
+    delta = delta_ref[0, 0, :]
     n_kb = t_kv // block_k
 
     def body(kb, acc):
@@ -154,10 +160,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
         ) * jnp.float32(scale)  # (BQ, BK)
-        if causal:
+        if causal or kv_len < t_kv:
             q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, jnp.float32(_NEG_INF))
+            valid = k_pos < kv_len  # zero-padded keys must not attend
+            if causal:
+                valid = valid & (q_pos >= k_pos)
+            s = jnp.where(valid, s, jnp.float32(_NEG_INF))
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
@@ -176,8 +185,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block
     dq_ref[0] = (acc * jnp.float32(scale)).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float, t_q: int):
-    # k/v/dk/dv: (1, BK, D); q/do: (1, T, D); lse/delta: (1, T, 1)
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float, t_q: int, kv_len: int):
+    # k/v/dk/dv: (1, BK, D); q/do: (1, T, D); lse/delta: (1, 1, T)
     ik = pl.program_id(1)
     bk = k_ref.shape[1]
     d = k_ref.shape[2]
@@ -190,15 +199,17 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dk, dv = carry
         qq = q_ref[0, pl.ds(qb * block_q, block_q), :]
         do = do_ref[0, pl.ds(qb * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
         s = jax.lax.dot_general(
             qq, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
         ) * jnp.float32(scale)  # (BQ, BK)
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+        valid = k_pos < kv_len  # zero-padded keys contribute nothing
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
-            k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, jnp.float32(_NEG_INF))
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, jnp.float32(_NEG_INF))
         p = jnp.exp(s - lse[:, None])  # (BQ, BK)
         dv = dv + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -226,22 +237,22 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
+def _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret, kv_len):
     bh, t, d = q.shape
     t_kv = k.shape[1]
     scale = 1.0 / math.sqrt(d)
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)  # (BH, T, 1)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[:, None, :]  # (BH, 1, T)
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale, t_kv=t_kv),
+        functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale, t_kv=t_kv, kv_len=kv_len),
         grid=(bh, t // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -249,15 +260,15 @@ def _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret)
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale, t_q=t),
+        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale, t_q=t, kv_len=kv_len),
         grid=(bh, t_kv // block_k),
         in_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, t, 1), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, t, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
@@ -272,14 +283,14 @@ def _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret)
     return dq, dk, dv
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, kv_len, res, do):
     # Pallas backward: recompute p = exp(q·kᵀ·scale − lse) block-wise in VMEM.
     # Two kernels — dq streams K/V blocks per query block; dk/dv streams Q/dO
     # blocks per key block (causal lower bound skips fully-masked blocks).
     # No (BQ,T) score block or (n_q,BH,T,D) intermediate ever reaches HBM.
     q, k, v, out, lse = res
     with jax.enable_x64(False):
-        return _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret)
+        return _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret, kv_len)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -315,14 +326,11 @@ def flash_attention_array(q, k, v, causal=False, block_q=512, block_k=512, inter
     if pad_q:
         qb = jnp.pad(qb, ((0, 0), (0, pad_q), (0, 0)))
     if pad_k:
+        # padded keys are masked inside the kernels via kv_len (k_pos >=
+        # kv_len contributes -inf scores), so any T_kv works non-causally too
         kb = jnp.pad(kb, ((0, 0), (0, pad_k), (0, 0)))
         vb = jnp.pad(vb, ((0, 0), (0, pad_k), (0, 0)))
-        if not causal:
-            # padded keys must not attend: give them -inf via a key mask by
-            # pushing k to a value that zeroes post-softmax contribution —
-            # handled by causal masking when causal; for non-causal fall back
-            raise ValueError("non-causal flash requires T_kv % block_k == 0")
-    out = _flash(qb, kb, vb, causal, block_q, block_k, interpret)
+    out = _flash(qb, kb, vb, causal, block_q, block_k, interpret, t_kv)
     if pad_q:
         out = out[:, :t]
     return jnp.swapaxes(out.reshape(b, h, t, d), 1, 2)

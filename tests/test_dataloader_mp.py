@@ -1,0 +1,105 @@
+"""Multiprocess DataLoader workers (io/_MultiprocessIter).
+
+Parity: reference ``fluid/dataloader/dataloader_iter.py:326`` —
+num_workers>0 forks worker PROCESSES (GIL-free preprocessing) feeding the
+consumer; order is preserved; worker exceptions surface on the consumer.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class SlowSquares(Dataset):
+    """CPU-heavy __getitem__ — the workload the GIL serializes on threads."""
+
+    def __init__(self, n=64, work=20000):
+        self.n = n
+        self.work = work
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        # pure-python work: holds the GIL on the thread path
+        acc = 0
+        for j in range(self.work):
+            acc = (acc + i * j) % 1000003
+        return np.asarray([i * i + (acc % 1)], dtype=np.float32), np.int64(i)
+
+
+class Failing(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.zeros(2, np.float32)
+
+
+class TestMultiprocessWorkers:
+    def test_order_and_values(self):
+        ds = SlowSquares(n=32, work=10)
+        loader = DataLoader(ds, batch_size=4, num_workers=3, shuffle=False)
+        seen = []
+        for x, y in loader:
+            assert x.shape == [4, 1]
+            seen.extend(int(v) for v in y.numpy())
+        assert seen == list(range(32))  # ordered despite parallel workers
+
+    def test_values_match_single_worker(self):
+        ds = SlowSquares(n=16, work=10)
+        a = [x.numpy() for x, _ in DataLoader(ds, batch_size=4, num_workers=0)]
+        b = [x.numpy() for x, _ in DataLoader(ds, batch_size=4, num_workers=2)]
+        for u, v in zip(a, b):
+            np.testing.assert_array_equal(u, v)
+
+    def test_worker_exception_surfaces(self):
+        loader = DataLoader(Failing(), batch_size=2, num_workers=2)
+        with pytest.raises(RuntimeError, match="boom at 5"):
+            for _ in loader:
+                pass
+
+    def test_workers_are_distinct_processes(self):
+        # true process workers (reference forks; threads would all report the
+        # parent pid and serialize python work on the GIL)
+        import os
+
+        class PidDataset(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.asarray([os.getpid()], dtype=np.int64)
+
+        parent = os.getpid()
+        loader = DataLoader(PidDataset(), batch_size=2, num_workers=4)
+        pids = set()
+        for batch in loader:
+            pids.update(int(p) for p in batch.numpy().ravel())
+        assert parent not in pids  # work happened off the main process
+        assert len(pids) >= 2  # spread across multiple workers
+
+    def test_parallel_speedup_on_gil_bound_work(self):
+        import os
+
+        if (os.cpu_count() or 1) < 4:
+            pytest.skip("needs >=4 cores for wall-clock speedup")
+        # threads can't scale pure-python __getitem__; processes can. Require
+        # a conservative 1.5x at 4 workers to stay CI-stable.
+        ds = SlowSquares(n=48, work=400000)
+
+        def run(workers):
+            loader = DataLoader(ds, batch_size=4, num_workers=workers)
+            t0 = time.time()
+            for _ in loader:
+                pass
+            return time.time() - t0
+
+        t1 = run(0)
+        t4 = run(4)
+        assert t4 < t1 / 1.5, f"no speedup: 1w={t1:.2f}s 4w={t4:.2f}s"

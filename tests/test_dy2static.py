@@ -1,0 +1,248 @@
+"""@to_static control-flow conversion (jit/dy2static.py).
+
+Acceptance patterns modeled on the reference's
+``unittests/dygraph_to_static/`` suite (test_ifelse.py, test_loop.py,
+test_logical.py): tensor-dependent if/while/for compile under to_static and
+match eager execution exactly.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32))
+
+
+class TestIfElse:
+    def test_tensor_if_both_paths(self):
+        def fn(x):
+            if x.mean() > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        st = paddle.jit.to_static(fn)
+        xp = _t([1.0, 2.0, 3.0])
+        xn = _t([-1.0, -2.0, -3.0])
+        np.testing.assert_allclose(st(xp).numpy(), fn(xp).numpy())
+        np.testing.assert_allclose(st(xn).numpy(), fn(xn).numpy())
+
+    def test_if_updates_existing_var(self):
+        def fn(x):
+            y = x + 1.0
+            if x.sum() > 100.0:
+                y = y * 10.0
+            else:
+                y = y / 2.0
+            return y
+
+        st = paddle.jit.to_static(fn)
+        x = _t([1.0, 2.0])
+        np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+
+    def test_nested_if(self):
+        def fn(x):
+            if x.mean() > 0:
+                if x.max() > 2.0:
+                    y = x * 3.0
+                else:
+                    y = x * 2.0
+            else:
+                y = -x
+            return y
+
+        st = paddle.jit.to_static(fn)
+        for a in ([1.0, 5.0], [1.0, 1.5], [-1.0, -2.0]):
+            x = _t(a)
+            np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+
+    def test_python_if_still_works(self):
+        def fn(x, flag=True):
+            if flag:  # plain python predicate
+                return x + 1.0
+            return x - 1.0
+
+        st = paddle.jit.to_static(fn)
+        x = _t([1.0])
+        np.testing.assert_allclose(st(x).numpy(), [2.0])
+
+
+class TestWhile:
+    def test_tensor_bounded_while(self):
+        def fn(x):
+            s = x * 0.0
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < 5.0:
+                s = s + x
+                i = i + 1.0
+            return s
+
+        st = paddle.jit.to_static(fn)
+        x = _t([1.0, 2.0])
+        np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+        np.testing.assert_allclose(st(x).numpy(), [5.0, 10.0])
+
+    def test_while_data_dependent_condition(self):
+        def fn(x):
+            # double until the sum crosses a data-dependent threshold
+            while x.sum() < 100.0:
+                x = x * 2.0
+            return x
+
+        st = paddle.jit.to_static(fn)
+        x = _t([1.0, 2.0])
+        np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+
+
+class TestLogical:
+    def test_and_or_not_on_tensors(self):
+        def fn(x):
+            if (x.mean() > 0.0) and (x.max() < 10.0):
+                y = x + 1.0
+            else:
+                y = x - 1.0
+            return y
+
+        st = paddle.jit.to_static(fn)
+        for a in ([1.0, 2.0], [1.0, 20.0], [-1.0, -2.0]):
+            x = _t(a)
+            np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+
+    def test_return_in_tensor_branch_raises_clearly(self):
+        def fn(x):
+            if x.mean() > 0.0:
+                return x + 1.0
+            return x - 1.0
+
+        st = paddle.jit.to_static(fn)
+        with pytest.raises(TypeError, match="traced Tensor"):
+            st(_t([1.0, 2.0]))
+
+
+class TestLayerForward:
+    def test_layer_with_tensor_if(self):
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.mean() > 0:
+                    out = F.relu(h)
+                else:
+                    out = h * 0.1
+                return out
+
+        paddle.seed(0)
+        g = Gate()
+        x = _t(np.random.RandomState(0).randn(2, 4))
+        eager = g(x).numpy()
+        st = paddle.jit.to_static(g)
+        np.testing.assert_allclose(st(x).numpy(), eager, rtol=1e-6)
+
+    def test_grads_flow_through_converted_if(self):
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.sum() > 0:
+                    y = h * 2.0
+                else:
+                    y = h * 3.0
+                return y.sum()
+
+        paddle.seed(1)
+        g1, g2 = Gate(), Gate()
+        g2.set_state_dict(g1.state_dict())
+        x = _t(np.random.RandomState(1).randn(2, 4))
+        g1(x).backward()
+        st = paddle.jit.to_static(g2)
+        st(x).backward()
+        np.testing.assert_allclose(
+            g1.fc.weight.grad.numpy(), g2.fc.weight.grad.numpy(), rtol=1e-5
+        )
+
+
+class TestForRange:
+    def test_for_over_tensor_range(self):
+        def fn(x, n):
+            s = x * 0.0
+            for i in range(n):
+                s = s + x
+            return s
+
+        st = paddle.jit.to_static(fn)
+        x = _t([1.0, 2.0])
+        n = paddle.to_tensor(np.int64(4))
+        np.testing.assert_allclose(st(x, n).numpy(), [4.0, 8.0])
+
+    def test_for_uses_index(self):
+        def fn(x, n):
+            s = x * 0.0
+            for i in range(n):
+                s = s + x * float(1.0) + i
+            return s
+
+        st = paddle.jit.to_static(fn)
+        x = _t([0.0, 0.0])
+        n = paddle.to_tensor(np.int64(3))
+        # s = sum_{i<3} (x + i) = 0+1+2 = 3
+        np.testing.assert_allclose(st(x, n).numpy(), [3.0, 3.0])
+
+    def test_negative_step_range_stays_python(self):
+        def make(n):
+            def fn(x):
+                s = x * 0.0
+                for i in range(n, 0, -1):
+                    s = s + i
+                return s
+
+            return fn
+
+        st = paddle.jit.to_static(make(3))
+        np.testing.assert_allclose(st(_t([0.0])).numpy(), [6.0])  # 3+2+1
+
+    def test_loop_var_after_loop_matches_python(self):
+        def fn(x, n):
+            s = x * 0.0
+            for i in range(n):
+                s = s + x
+            return s + i
+
+        st = paddle.jit.to_static(fn)
+        x = _t([1.0, 1.0])
+        n = paddle.to_tensor(np.int64(3))
+        # python: i ends at 2 → 3 + 2 = 5
+        np.testing.assert_allclose(st(x, n).numpy(), [5.0, 5.0])
+
+
+class TestTransformScope:
+    def test_closure_overrides_global(self):
+        def make(thresh):
+            def fn(x):
+                if x.mean() > thresh:
+                    y = x * 2.0
+                else:
+                    y = x * 0.0
+                return y
+            return fn
+
+        st = paddle.jit.to_static(make(100.0))
+        np.testing.assert_allclose(st(_t([1.0, 1.0])).numpy(), [0.0, 0.0])
+
+    def test_no_control_flow_keeps_live_globals(self):
+        import types
+        mod = types.ModuleType("m_live")
+        exec("SCALE = 1.0\ndef f(x):\n    return x * SCALE\n", mod.__dict__)
+        st = paddle.jit.to_static(mod.f)
+        mod.SCALE = 3.0
+        np.testing.assert_allclose(st(_t([1.0])).numpy(), [3.0])
