@@ -191,6 +191,56 @@ def bench_resnet50_aot(paddle, jax, np, on_tpu):
     }
 
 
+def bench_resnet50_int8(paddle, jax, np, on_tpu):
+    """ResNet-50 int8 serving (PTQ → int8 swap → Predictor) vs the bf16/f32
+    AOT number above — the slim→AnalysisPredictor int8 capability."""
+    from paddle_tpu.vision.models import resnet50
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.quantization import PostTrainingQuantization, convert_to_int8_inference
+
+    paddle.seed(0)
+    model = resnet50()
+    model.eval()
+    batch = 32 if on_tpu else 4
+    steps = 20 if on_tpu else 3
+
+    class Calib(paddle.io.Dataset):
+        def __len__(self):
+            return 2
+
+        def __getitem__(self, i):
+            return np.random.RandomState(i).randn(3, 224, 224).astype(np.float32)
+
+    loader = paddle.io.DataLoader(Calib(), batch_size=2, num_workers=0)
+    ptq = PostTrainingQuantization(model, data_loader=loader, batch_nums=1)
+    ptq.quantize()
+    convert_to_int8_inference(model, ptq)
+
+    d = tempfile.mkdtemp()
+    prefix = os.path.join(d, "resnet50_int8")
+    paddle.static.save_inference_model(
+        prefix, [InputSpec([batch, 3, 224, 224], "float32", name="image")], model
+    )
+    pred = create_predictor(Config(prefix))
+    shutil.rmtree(d, ignore_errors=True)
+    x = np.random.RandomState(0).randn(batch, 3, 224, 224).astype(np.float32)
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.share_external_data(jax.device_put(jax.numpy.asarray(x)))
+    out_h = pred.get_output_handle(pred.get_output_names()[0])
+    pred.run(); out_h.copy_to_cpu()
+    pred.run(); out_h.copy_to_cpu()
+    t0 = time.time()
+    for _ in range(steps):
+        pred.run()
+    pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu().sum()
+    dt = time.time() - t0
+    return {
+        "name": f"ResNet-50 int8 AOT inference (b{batch}, Predictor)",
+        "imgs_per_sec": round(batch * steps / dt, 1),
+    }
+
+
 def bench_lenet_eager(paddle, jax, np, on_tpu):
     """LeNet eager train step — per-op dispatch overhead (first E2E slice)."""
     from paddle_tpu.vision.models import LeNet
@@ -235,7 +285,8 @@ def main():
 
     gpt = bench_gpt(paddle, jax, np, on_tpu)
     extras = []
-    for fn in (bench_resnet50_aot, bench_lenet_eager, bench_gpt_1p3b, bench_gpt_8k_flash):
+    for fn in (bench_resnet50_aot, bench_resnet50_int8, bench_lenet_eager,
+               bench_gpt_1p3b, bench_gpt_8k_flash):
         try:
             extras.append(fn(paddle, jax, np, on_tpu))
         except Exception as e:  # a broken extra must not kill the primary line

@@ -1,0 +1,165 @@
+"""Host-offloaded giant embedding — the TPU-first parameter-server answer.
+
+Parity (capability, not design): the reference's brpc PS serves embedding
+tables far larger than device memory — in-RAM
+``distributed/ps/table/memory_sparse_table.cc``, disk-backed
+``ssd_sparse_table.cc``, runtime ``fleet/runtime/the_one_ps.py:606``, lookup
+``operators/pscore/distributed_lookup_table_op``, and SelectedRows sparse
+optimizer rules (``table/sparse_sgd_rule.cc``). On TPU the idiomatic
+replacement is not an RPC server: the table lives in HOST memory (plain RAM
+or a numpy memmap, which makes the LOGICAL size disk-bound, like the SSD
+table), each step gathers only the touched rows to HBM, and the sparse
+optimizer update is applied host-side to exactly those rows
+(SelectedRows-style). HBM holds O(unique ids per batch × dim), never the
+table.
+
+Flow per step (mirrors PS pull → dense compute → push):
+    ids → unique (host) → table.gather(uniq) → device leaf tensor `rows`
+    → out = rows[inverse]  (differentiable gather on device)
+    → backward gives rows.grad (dense, small)
+    → apply_gradients(): host scatter-update of the touched rows
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import as_tensor, eager_call
+from ..core.lazy import concrete as _concrete
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["HostEmbeddingTable", "HostEmbedding"]
+
+
+class HostEmbeddingTable:
+    """Row store in host RAM or a memmap file (logical size disk-bound; the
+    file is sparse, so untouched rows occupy no physical pages)."""
+
+    def __init__(
+        self,
+        num_embeddings: int,
+        embedding_dim: int,
+        dtype="float32",
+        path: Optional[str] = None,
+        init_std: float = 0.01,
+        seed: int = 0,
+        optimizer: str = "sgd",
+        adagrad_eps: float = 1e-8,
+    ):
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.dtype = np.dtype(dtype)
+        self.init_std = float(init_std)
+        self.seed = int(seed)
+        self.optimizer = optimizer
+        self.adagrad_eps = float(adagrad_eps)
+        shape = (self.num_embeddings, self.embedding_dim)
+        if path is not None:
+            self.table = np.lib.format.open_memmap(
+                path, mode="w+", dtype=self.dtype, shape=shape
+            )
+            if optimizer == "adagrad":
+                self._accum = np.lib.format.open_memmap(
+                    path + ".accum", mode="w+", dtype=np.float32,
+                    shape=(self.num_embeddings,),
+                )
+            else:
+                self._accum = None
+        else:
+            self.table = np.zeros(shape, self.dtype)
+            self._accum = (
+                np.zeros((self.num_embeddings,), np.float32)
+                if optimizer == "adagrad"
+                else None
+            )
+        # lazy per-row init: rows materialize with N(0, init_std) on first
+        # touch (deterministic per row), so a 20GB-logical table costs
+        # nothing until used — the reference's sparse tables create entries
+        # on first feature occurrence the same way
+        self._initialized = np.zeros(self.num_embeddings, bool)
+
+    def _ensure_init(self, ids: np.ndarray):
+        fresh = ids[~self._initialized[ids]]
+        if fresh.size == 0:
+            return
+        for r in fresh:
+            rng = np.random.default_rng(self.seed * 0x9E3779B1 + int(r))
+            self.table[r] = rng.normal(0.0, self.init_std, self.embedding_dim).astype(self.dtype)
+        self._initialized[fresh] = True
+
+    def gather(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        self._ensure_init(ids)
+        return np.asarray(self.table[ids])
+
+    def apply_update(self, ids: np.ndarray, grad: np.ndarray, lr: float):
+        """SelectedRows-style sparse optimizer step on the touched rows
+        (reference sparse_sgd_rule.cc: SGD / rowwise Adagrad)."""
+        ids = np.asarray(ids, np.int64)
+        grad = np.asarray(grad, np.float32)
+        if self.optimizer == "adagrad":
+            g2 = (grad * grad).mean(axis=1)
+            self._accum[ids] += g2
+            scale = lr / (np.sqrt(self._accum[ids]) + self.adagrad_eps)
+            self.table[ids] = (
+                self.table[ids].astype(np.float32) - scale[:, None] * grad
+            ).astype(self.dtype)
+        else:  # sgd
+            self.table[ids] = (
+                self.table[ids].astype(np.float32) - lr * grad
+            ).astype(self.dtype)
+
+    def state_nbytes_physical(self) -> int:
+        """Resident bytes of the backing file (0 blocks for untouched rows)."""
+        if isinstance(self.table, np.memmap):
+            st = os.stat(self.table.filename)
+            return st.st_blocks * 512
+        return self.table.nbytes
+
+
+class HostEmbedding(Layer):
+    """Embedding layer over a HostEmbeddingTable.
+
+    Eager-mode by design: the gather crosses the host boundary, exactly like
+    the reference's PS pull — the dense model around it can still run
+    compiled. Call ``apply_gradients(lr)`` after ``backward()`` (the role of
+    the PS push / SelectedRows optimizer)."""
+
+    def __init__(self, num_embeddings, embedding_dim, path=None, optimizer="sgd",
+                 init_std=0.01, seed=0, sparse=True, name=None):
+        super().__init__()
+        self.table = HostEmbeddingTable(
+            num_embeddings, embedding_dim, path=path, optimizer=optimizer,
+            init_std=init_std, seed=seed,
+        )
+        self._pending = []  # (unique_ids, rows_tensor) awaiting push
+
+    def forward(self, x):
+        xt = as_tensor(x)
+        ids = np.asarray(_concrete(xt._data)).astype(np.int64)
+        uniq, inverse = np.unique(ids.ravel(), return_inverse=True)
+        rows = Tensor(jnp.asarray(self.table.gather(uniq)), stop_gradient=False)
+        if self.training:
+            self._pending.append((uniq, rows))
+        inv = Tensor(jnp.asarray(inverse.reshape(ids.shape)))
+
+        out = eager_call(
+            "host_embedding_select",
+            lambda r, iv: r[iv],
+            [rows, inv],
+        )
+        return out
+
+    def apply_gradients(self, lr: float):
+        """Push: apply accumulated sparse grads to the host table."""
+        for uniq, rows in self._pending:
+            if rows.grad is not None:
+                self.table.apply_update(uniq, np.asarray(_concrete(rows.grad._data)), lr)
+        self._pending = []
+
+    def embedding_dim(self):
+        return self.table.embedding_dim

@@ -253,11 +253,110 @@ def _build_decode(arch, T0, T_max, max_new_tokens, temperature, top_k, top_p,
     return decode
 
 
+def _build_beam_decode(arch, T0, T_max, max_new_tokens, num_beams, eos_token_id,
+                       length_penalty):
+    """Beam search inside ONE jitted program (reference
+    ``operators/math/beam_search.cc`` + ``beam_search_op``/
+    ``beam_search_decode_op`` roles): the KV caches are stacked per beam
+    (B·K leading dim) and re-gathered along the beam axis every step inside
+    the ``lax.fori_loop`` carry — no host round trips."""
+    KV, D = arch["kv_heads"], arch["head_dim"]
+    K = int(num_beams)
+
+    def decode(params, ids, key):
+        layer_ws = params["layers"]
+        B = ids.shape[0]
+
+        # ---- prefill on the raw batch, then tile caches across beams ------
+        x = arch["embed_prompt"](params, ids, T0)
+        caches = []
+        for w in layer_ws:
+            x, (k, v) = arch["block"](w, x)
+            kc = jnp.zeros((B, T_max, KV, D), x.dtype).at[:, :T0].set(k)
+            vc = jnp.zeros((B, T_max, KV, D), x.dtype).at[:, :T0].set(v)
+            caches.append(
+                (jnp.repeat(kc, K, axis=0), jnp.repeat(vc, K, axis=0))
+            )
+        logits0 = jnp.repeat(arch["head"](params, x), K, axis=0)  # (B*K, V)
+
+        out = jnp.zeros((B * K, T_max), jnp.int32).at[:, :T0].set(
+            jnp.repeat(ids, K, axis=0)
+        )
+        # only beam 0 is live initially so step 1 draws K distinct tokens
+        scores = jnp.tile(
+            jnp.asarray([0.0] + [-1e30] * (K - 1), jnp.float32), (B, 1)
+        )  # (B, K)
+        finished = jnp.zeros((B, K), bool)
+
+        def gather_beams(t, beam_idx):
+            # t: (B*K, ...) → reorder rows by beam_idx (B, K)
+            flat = beam_idx + (jnp.arange(B) * K)[:, None]  # (B, K) global rows
+            return jnp.take(t, flat.reshape(-1), axis=0)
+
+        def step(i, carry):
+            out, caches, scores, finished, logits = carry
+            V = logits.shape[-1]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            logp = logp.reshape(B, K, V)
+            if eos_token_id is not None:
+                # a finished beam may only extend with eos at no cost
+                eos_only = jnp.full((V,), -jnp.inf).at[eos_token_id].set(0.0)
+                logp = jnp.where(finished[..., None], eos_only[None, None], logp)
+            total = scores[..., None] + logp  # (B, K, V)
+            flat = total.reshape(B, K * V)
+            new_scores, idx = lax.top_k(flat, K)  # (B, K)
+            beam_idx = idx // V
+            token = (idx % V).astype(jnp.int32)
+
+            out = gather_beams(out, beam_idx)
+            caches = tuple(
+                (gather_beams(kc, beam_idx), gather_beams(vc, beam_idx))
+                for kc, vc in caches
+            )
+            finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+            if eos_token_id is not None:
+                finished = finished | (token == eos_token_id)
+
+            pos = T0 + i
+            out = lax.dynamic_update_slice(out, token.reshape(-1)[:, None], (0, pos))
+            x = arch["embed_token"](params, token.reshape(-1), pos)
+            new_caches = []
+            for w, kv in zip(layer_ws, caches):
+                x, kv = arch["block"](w, x, kv=kv, pos=pos)
+                new_caches.append(kv)
+            logits = arch["head"](params, x)
+            return out, tuple(new_caches), new_scores, finished, logits
+
+        out, _, scores, _, _ = lax.fori_loop(
+            0, max_new_tokens, step,
+            (out, tuple(caches), scores, finished, logits0),
+        )
+        # GNMT-style length penalty (reference beam_search length
+        # normalization); generated length is uniform here so it only
+        # matters when eos ended beams early — scores already froze then
+        norm = scores / (float(T0 + max_new_tokens) ** float(length_penalty))
+        best = jnp.argmax(norm, axis=1)  # (B,)
+        rows = best + jnp.arange(B) * K
+        return jnp.take(out, rows, axis=0)
+
+    return decode
+
+
 def _run(arch_key, arch, params, ids_in, T0, max_new_tokens, temperature,
-         top_k, top_p, eos_token_id, do_sample):
+         top_k, top_p, eos_token_id, do_sample, num_beams=1, length_penalty=0.0):
     B = ids_in.shape[0]
     T_max = T0 + int(max_new_tokens)
     key = random_state.next_key()
+    if num_beams and int(num_beams) > 1:
+        cache_key = arch_key + ("beam", B, T0, int(max_new_tokens),
+                                int(num_beams), eos_token_id, float(length_penalty))
+        fn = _DECODE_CACHE.get(cache_key)
+        if fn is None:
+            fn = jax.jit(_build_beam_decode(
+                arch, T0, T_max, int(max_new_tokens), int(num_beams),
+                eos_token_id, float(length_penalty)))
+            _DECODE_CACHE[cache_key] = fn
+        return Tensor(fn(params, ids_in, key), stop_gradient=True)
     cache_key = arch_key + (B, T0, int(max_new_tokens), float(temperature),
                             int(top_k), float(top_p), eos_token_id,
                             bool(do_sample))
@@ -280,6 +379,8 @@ def generate(
     top_p: float = 1.0,
     eos_token_id: Optional[int] = None,
     do_sample: bool = True,
+    num_beams: int = 1,
+    length_penalty: float = 0.0,
 ):
     """Sample continuations for a GPTForPretraining-style model. Returns
     (B, T_prompt + max_new_tokens) int ids (generation stops writing after
@@ -314,7 +415,8 @@ def generate(
     }
     arch_key = ("gpt", H, D, len(params["layers"]))
     return _run(arch_key, _gpt_arch(H, D), params, ids, T0, max_new_tokens,
-                temperature, top_k, top_p, eos_token_id, do_sample)
+                temperature, top_k, top_p, eos_token_id, do_sample,
+                num_beams=num_beams, length_penalty=length_penalty)
 
 
 @no_grad()

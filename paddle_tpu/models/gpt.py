@@ -267,16 +267,19 @@ class GPTForPretraining(nn.Layer):
         )
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
-                 top_p=1.0, eos_token_id=None, do_sample=True):
+                 top_p=1.0, eos_token_id=None, do_sample=True, num_beams=1,
+                 length_penalty=0.0):
         """KV-cached compiled autoregressive decoding (see
         models/generation.py — prefill + lax.fori_loop sampling in ONE jitted
-        program; the reference's top_k/multinomial/beam_search op roles)."""
+        program; the reference's top_k/multinomial/beam_search op roles).
+        ``num_beams>1`` runs stacked-beam search (beam_search_op role)."""
         from .generation import generate as _generate
 
         return _generate(
             self, input_ids, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_token_id=eos_token_id, do_sample=do_sample,
+            num_beams=num_beams, length_penalty=length_penalty,
         )
 
 

@@ -174,11 +174,11 @@ class PostTrainingQuantization:
         self.types = tuple(quantizable_layer_type or ImperativeQuantAware.QUANTIZABLE)
         self.batch_nums = batch_nums
         self.act_scales = {}
+        self.in_scales = {}
         self.weight_scales = {}
 
     def _collect(self, layer_name):
         def hook(layer, inputs, output):
-
             arr = _concrete(output._data if isinstance(output, Tensor) else output)
             cur = float(jnp.max(jnp.abs(arr)))
             if self.algo == "avg":
@@ -186,6 +186,12 @@ class PostTrainingQuantization:
                 self.act_scales[layer_name] = cur if prev is None else 0.5 * (prev + cur)
             else:  # abs_max
                 self.act_scales[layer_name] = max(self.act_scales.get(layer_name, 0.0), cur)
+            # INPUT scale too: the int8 serving path quantizes activations
+            # entering the layer (x_int8 @ w_int8 -> int32 on the MXU)
+            x0 = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+            xin = _concrete(x0._data if isinstance(x0, Tensor) else x0)
+            cin = float(jnp.max(jnp.abs(xin)))
+            self.in_scales[layer_name] = max(self.in_scales.get(layer_name, 0.0), cin)
         return hook
 
     def quantize(self):
@@ -216,8 +222,127 @@ class PostTrainingQuantization:
         return self.model
 
 
+# -- int8 serving path -------------------------------------------------------
+
+class Int8Linear(Layer):
+    """Serving-time int8 linear: x and W quantize to int8, the matmul
+    accumulates in int32 on the MXU, one fp rescale at the end. The role of
+    the reference's int8 pass pipeline feeding AnalysisPredictor
+    (``contrib/slim/quantization/quantization_pass.py:269`` →
+    quantized conv/mul kernels); here the int8 weights export as int8
+    StableHLO constants, so the AOT artifact is int8 end to end."""
+
+    def __init__(self, weight_q, bias, in_scale: float, w_scale: float):
+        super().__init__()
+        self.register_buffer("weight_q", Tensor(weight_q, stop_gradient=True))
+        self.bias = bias
+        self._sx = float(in_scale) / 127.0
+        self._sw = float(w_scale) / 127.0
+
+    def forward(self, x):
+        xt = as_tensor(x)
+        args = [xt, self.weight_q] + ([self.bias] if self.bias is not None else [])
+
+        def fn(a, wq, *rest, sx=self._sx, sw=self._sw):
+            aq = jnp.clip(jnp.round(a / sx), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                aq, wq, (((a.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            y = acc.astype(jnp.float32) * (sx * sw)
+            if rest:
+                y = y + rest[0]
+            return y.astype(a.dtype)
+
+        return eager_call("int8_linear", fn, args, differentiable=False)
+
+
+class Int8Conv2D(Layer):
+    """Serving-time int8 conv2d (NCHW): int8 feature/filter, int32 MXU
+    accumulation, single fp rescale."""
+
+    def __init__(self, weight_q, bias, in_scale: float, w_scale: float,
+                 stride, padding, dilation, groups):
+        super().__init__()
+        self.register_buffer("weight_q", Tensor(weight_q, stop_gradient=True))
+        self.bias = bias
+        self._sx = float(in_scale) / 127.0
+        self._sw = float(w_scale) / 127.0
+        def _pair(v):
+            return tuple(v) if isinstance(v, (list, tuple)) else (int(v), int(v))
+
+        self._cfg = (_pair(stride), padding, _pair(dilation), int(groups))
+
+    def forward(self, x):
+        xt = as_tensor(x)
+        args = [xt, self.weight_q] + ([self.bias] if self.bias is not None else [])
+        stride, padding, dilation, groups = self._cfg
+
+        def fn(a, wq, *rest, sx=self._sx, sw=self._sw):
+            aq = jnp.clip(jnp.round(a / sx), -127, 127).astype(jnp.int8)
+            pad = [(p, p) for p in padding] if isinstance(padding, tuple) else padding
+            acc = jax.lax.conv_general_dilated(
+                aq, wq, window_strides=stride, padding=pad,
+                rhs_dilation=dilation, feature_group_count=groups,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                preferred_element_type=jnp.int32,
+            )
+            y = acc.astype(jnp.float32) * (sx * sw)
+            if rest:
+                y = y + rest[0].reshape(1, -1, 1, 1)
+            return y.astype(a.dtype)
+
+        return eager_call("int8_conv2d", fn, args, differentiable=False)
+
+
+def convert_to_int8_inference(model: Layer, ptq: "PostTrainingQuantization"):
+    """Swap calibrated Linear/Conv2D sublayers for int8 serving layers, in
+    place. ``paddle.jit.save`` of the result emits an int8-weight StableHLO
+    artifact that ``paddle_tpu.inference.create_predictor`` runs as-is —
+    the slim → AnalysisPredictor integration of the reference."""
+    from ..core.lazy import concrete as _conc
+
+    def swap(parent, prefix=""):
+        for name, child in list(parent._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            tname = type(child).__name__
+            scale_key = _match_scale(ptq, full)
+            if tname == "Linear" and scale_key is not None:
+                w = np.asarray(_conc(child.weight._data), np.float32)
+                s_w = float(np.maximum(np.abs(w).max(), 1e-8))
+                wq = np.clip(np.round(w / s_w * 127.0), -127, 127).astype(np.int8)
+                parent._sub_layers[name] = Int8Linear(
+                    jnp.asarray(wq), child.bias, ptq.in_scales[scale_key], s_w
+                )
+            elif tname == "Conv2D" and scale_key is not None:
+                w = np.asarray(_conc(child.weight._data), np.float32)
+                s_w = float(np.maximum(np.abs(w).max(), 1e-8))
+                wq = np.clip(np.round(w / s_w * 127.0), -127, 127).astype(np.int8)
+                pad = child._padding
+                pad_t = tuple(pad) if isinstance(pad, (list, tuple)) else (int(pad),) * 2
+                parent._sub_layers[name] = Int8Conv2D(
+                    jnp.asarray(wq), child.bias, ptq.in_scales[scale_key], s_w,
+                    child._stride, pad_t, child._dilation, child._groups,
+                )
+            else:
+                swap(child, full)
+    swap(model)
+    return model
+
+
+def _match_scale(ptq, full_name):
+    if full_name in ptq.in_scales:
+        return full_name
+    # named_sublayers prefixes may differ by a leading module name
+    for k in ptq.in_scales:
+        if k.endswith(full_name) or full_name.endswith(k):
+            return k
+    return None
+
+
 __all__ = [
     "fake_quantize_dequantize_abs_max", "quantize_to_int8",
     "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax", "QuantedLayer",
     "ImperativeQuantAware", "PostTrainingQuantization",
+    "Int8Linear", "Int8Conv2D", "convert_to_int8_inference",
 ]

@@ -1,0 +1,239 @@
+// C inference API implementation — embeds (or attaches to) the Python
+// runtime and drives paddle_tpu.inference.Predictor through the CPython API.
+// See paddle_tpu_c.h for the contract; role parity with the reference's
+// capi_exp/pd_inference_api (C ABI over the predictor lifecycle).
+#include "paddle_tpu_c.h"
+
+#include <Python.h>
+
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+void set_py_error(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = where;
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg += ": ";
+      msg += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+struct GIL {
+  PyGILState_STATE st;
+  GIL() : st(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+struct PD_Predictor {
+  PyObject* predictor = nullptr;   // paddle_tpu.inference.Predictor
+  std::string scratch_name;        // storage for returned name pointers
+};
+
+extern "C" {
+
+int PD_Init(const char* repo_root) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  GIL gil;
+  if (repo_root != nullptr) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* root = PyUnicode_FromString(repo_root);
+    if (sys_path != nullptr && root != nullptr) {
+      PyList_Insert(sys_path, 0, root);
+    }
+    Py_XDECREF(root);
+  }
+  return 0;
+}
+
+PD_Predictor* PD_PredictorCreate(const char* model_prefix) {
+  if (!Py_IsInitialized()) {
+    PD_Init(nullptr);
+  }
+  GIL gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (mod == nullptr) {
+    set_py_error("import paddle_tpu.inference");
+    return nullptr;
+  }
+  PyObject* cfg_cls = PyObject_GetAttrString(mod, "Config");
+  PyObject* create = PyObject_GetAttrString(mod, "create_predictor");
+  PyObject* cfg = cfg_cls ? PyObject_CallFunction(cfg_cls, "s", model_prefix) : nullptr;
+  PyObject* pred = (create && cfg) ? PyObject_CallFunctionObjArgs(create, cfg, nullptr) : nullptr;
+  Py_XDECREF(cfg_cls);
+  Py_XDECREF(create);
+  Py_XDECREF(cfg);
+  Py_DECREF(mod);
+  if (pred == nullptr) {
+    set_py_error("create_predictor");
+    return nullptr;
+  }
+  auto* p = new PD_Predictor();
+  p->predictor = pred;
+  return p;
+}
+
+static PyObject* get_handle(PD_Predictor* p, const char* name, bool input) {
+  PyObject* h = PyObject_CallMethod(
+      p->predictor, input ? "get_input_handle" : "get_output_handle", "s", name);
+  if (h == nullptr) set_py_error("get_handle");
+  return h;
+}
+
+int PD_PredictorSetInputFloat(PD_Predictor* p, const char* name,
+                              const float* data, const int64_t* shape,
+                              int ndim) {
+  GIL gil;
+  int64_t numel = 1;
+  for (int i = 0; i < ndim; ++i) numel *= shape[i];
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (np == nullptr) { set_py_error("import numpy"); return -1; }
+  // build numpy array via frombuffer(bytes).reshape(shape).copy()
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), numel * sizeof(float));
+  PyObject* arr = bytes ? PyObject_CallMethod(np, "frombuffer", "Os", bytes, "float32") : nullptr;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* reshaped = arr ? PyObject_CallMethod(arr, "reshape", "O", shp) : nullptr;
+  PyObject* owned = reshaped ? PyObject_CallMethod(reshaped, "copy", nullptr) : nullptr;
+  Py_XDECREF(bytes);
+  Py_XDECREF(arr);
+  Py_XDECREF(shp);
+  Py_XDECREF(reshaped);
+  Py_DECREF(np);
+  if (owned == nullptr) { set_py_error("build input array"); return -1; }
+  PyObject* h = get_handle(p, name, true);
+  PyObject* r = h ? PyObject_CallMethod(h, "copy_from_cpu", "O", owned) : nullptr;
+  Py_XDECREF(owned);
+  Py_XDECREF(h);
+  if (r == nullptr) { set_py_error("copy_from_cpu"); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int PD_PredictorRun(PD_Predictor* p) {
+  GIL gil;
+  PyObject* r = PyObject_CallMethod(p->predictor, "run", nullptr);
+  if (r == nullptr) { set_py_error("run"); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+static PyObject* output_numpy(PD_Predictor* p, const char* name) {
+  PyObject* h = get_handle(p, name, false);
+  if (h == nullptr) return nullptr;
+  PyObject* arr = PyObject_CallMethod(h, "copy_to_cpu", nullptr);
+  Py_DECREF(h);
+  if (arr == nullptr) set_py_error("copy_to_cpu");
+  return arr;
+}
+
+int64_t PD_PredictorOutputNumel(PD_Predictor* p, const char* name) {
+  GIL gil;
+  PyObject* arr = output_numpy(p, name);
+  if (arr == nullptr) return -1;
+  PyObject* size = PyObject_GetAttrString(arr, "size");
+  int64_t n = size ? PyLong_AsLongLong(size) : -1;
+  Py_XDECREF(size);
+  Py_DECREF(arr);
+  return n;
+}
+
+int PD_PredictorOutputShape(PD_Predictor* p, const char* name, int64_t* shape,
+                            int* ndim) {
+  GIL gil;
+  PyObject* arr = output_numpy(p, name);
+  if (arr == nullptr) return -1;
+  PyObject* shp = PyObject_GetAttrString(arr, "shape");
+  if (shp == nullptr) { Py_DECREF(arr); set_py_error("shape"); return -1; }
+  Py_ssize_t n = PyTuple_Size(shp);
+  if (n > 8) n = 8;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    shape[i] = PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+  }
+  *ndim = static_cast<int>(n);
+  Py_DECREF(shp);
+  Py_DECREF(arr);
+  return 0;
+}
+
+int PD_PredictorGetOutputFloat(PD_Predictor* p, const char* name, float* buf,
+                               int64_t buf_elems) {
+  GIL gil;
+  PyObject* arr = output_numpy(p, name);
+  if (arr == nullptr) return -1;
+  // float32 contiguous bytes
+  PyObject* f32 = PyObject_CallMethod(arr, "astype", "s", "float32");
+  PyObject* contig = f32 ? PyObject_CallMethod(f32, "ravel", nullptr) : nullptr;
+  PyObject* bytes = contig ? PyObject_CallMethod(contig, "tobytes", nullptr) : nullptr;
+  Py_XDECREF(f32);
+  Py_XDECREF(contig);
+  Py_DECREF(arr);
+  if (bytes == nullptr) { set_py_error("tobytes"); return -1; }
+  char* src = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(bytes, &src, &len);
+  int64_t elems = len / static_cast<int64_t>(sizeof(float));
+  if (elems > buf_elems) {
+    Py_DECREF(bytes);
+    set_error("output larger than buffer");
+    return -1;
+  }
+  memcpy(buf, src, elems * sizeof(float));
+  Py_DECREF(bytes);
+  return 0;
+}
+
+static const char* io_name(PD_Predictor* p, int index, bool input) {
+  GIL gil;
+  PyObject* names = PyObject_CallMethod(
+      p->predictor, input ? "get_input_names" : "get_output_names", nullptr);
+  if (names == nullptr) { set_py_error("get_names"); return nullptr; }
+  PyObject* item = PySequence_GetItem(names, index);
+  Py_DECREF(names);
+  if (item == nullptr) { set_py_error("name index"); return nullptr; }
+  p->scratch_name = PyUnicode_AsUTF8(item);
+  Py_DECREF(item);
+  return p->scratch_name.c_str();
+}
+
+const char* PD_PredictorInputName(PD_Predictor* p, int index) {
+  return io_name(p, index, true);
+}
+
+const char* PD_PredictorOutputName(PD_Predictor* p, int index) {
+  return io_name(p, index, false);
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (p == nullptr) return;
+  {
+    GIL gil;
+    Py_XDECREF(p->predictor);
+  }
+  delete p;
+}
+
+const char* PD_LastError(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

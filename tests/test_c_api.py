@@ -1,0 +1,92 @@
+"""C inference API (runtime_cpp/paddle_tpu_c.{h,cc}) — smoke test via ctypes.
+
+Parity: reference ``inference/capi_exp/pd_inference_api.h`` lifecycle
+(create → set input → run → get output) over the StableHLO AOT Predictor.
+"""
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "runtime_cpp", "libpaddle_tpu_c.so")
+
+
+def _build_lib():
+    if not os.path.exists(LIB):
+        subprocess.run(["make", "-C", os.path.join(ROOT, "runtime_cpp")], check=True)
+    return os.path.exists(LIB)
+
+
+@pytest.fixture(scope="module")
+def capi():
+    if not _build_lib():
+        pytest.skip("C API library unavailable")
+    lib = ctypes.CDLL(LIB)
+    lib.PD_PredictorCreate.restype = ctypes.c_void_p
+    lib.PD_PredictorCreate.argtypes = [ctypes.c_char_p]
+    lib.PD_PredictorSetInputFloat.restype = ctypes.c_int
+    lib.PD_PredictorSetInputFloat.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+    ]
+    lib.PD_PredictorRun.restype = ctypes.c_int
+    lib.PD_PredictorRun.argtypes = [ctypes.c_void_p]
+    lib.PD_PredictorOutputNumel.restype = ctypes.c_int64
+    lib.PD_PredictorOutputNumel.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.PD_PredictorGetOutputFloat.restype = ctypes.c_int
+    lib.PD_PredictorGetOutputFloat.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+    ]
+    lib.PD_PredictorInputName.restype = ctypes.c_char_p
+    lib.PD_PredictorInputName.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.PD_PredictorOutputName.restype = ctypes.c_char_p
+    lib.PD_PredictorOutputName.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.PD_PredictorDestroy.argtypes = [ctypes.c_void_p]
+    lib.PD_LastError.restype = ctypes.c_char_p
+    return lib
+
+
+class TestCAPI:
+    def test_create_run_get_output(self, capi, tmp_path):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+        model.eval()
+        prefix = str(tmp_path / "mlp")
+        paddle.static.save_inference_model(
+            prefix, [InputSpec([2, 4], "float32", name="x")], model
+        )
+
+        p = capi.PD_PredictorCreate(prefix.encode())
+        assert p, capi.PD_LastError().decode()
+
+        in_name = capi.PD_PredictorInputName(p, 0)
+        out_name = capi.PD_PredictorOutputName(p, 0)
+        assert in_name and out_name
+
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        shape = (ctypes.c_int64 * 2)(2, 4)
+        rc = capi.PD_PredictorSetInputFloat(
+            p, in_name, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), shape, 2
+        )
+        assert rc == 0, capi.PD_LastError().decode()
+        assert capi.PD_PredictorRun(p) == 0, capi.PD_LastError().decode()
+
+        n = capi.PD_PredictorOutputNumel(p, out_name)
+        assert n == 6
+        buf = (ctypes.c_float * n)()
+        rc = capi.PD_PredictorGetOutputFloat(p, out_name, buf, n)
+        assert rc == 0, capi.PD_LastError().decode()
+        got = np.frombuffer(buf, np.float32).reshape(2, 3)
+
+        want = model(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        capi.PD_PredictorDestroy(p)

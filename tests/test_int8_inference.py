@@ -1,0 +1,68 @@
+"""int8 serving path: PTQ calibration → int8 layer swap → jit.save →
+Predictor (quantization/convert_to_int8_inference; role of the reference's
+slim quantization passes feeding AnalysisPredictor)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.quantization import (
+    PostTrainingQuantization,
+    convert_to_int8_inference,
+)
+from paddle_tpu.static import InputSpec
+
+
+def _calibrated_model():
+    paddle.seed(0)
+    model = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+        nn.Conv2D(8, 8, 3, padding=1), nn.ReLU(),
+        nn.Flatten(), nn.Linear(8 * 8 * 8, 10),
+    )
+    model.eval()
+
+    class Calib(paddle.io.Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return np.random.RandomState(i).randn(3, 8, 8).astype(np.float32)
+
+    loader = paddle.io.DataLoader(Calib(), batch_size=2, num_workers=0)
+    ptq = PostTrainingQuantization(model, data_loader=loader, batch_nums=2)
+    ptq.quantize()
+    return model, ptq
+
+
+class TestInt8Inference:
+    def test_int8_swap_outputs_close_to_float(self):
+        model, ptq = _calibrated_model()
+        x = paddle.to_tensor(np.random.RandomState(9).randn(2, 3, 8, 8).astype(np.float32))
+        ref = model(x).numpy()
+        convert_to_int8_inference(model, ptq)
+        got = model(x).numpy()
+        # per-tensor int8: coarse but bounded error
+        rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6)
+        assert rel < 0.12, f"int8 drift {rel:.3f}"
+
+    def test_int8_artifact_through_predictor(self, tmp_path):
+        model, ptq = _calibrated_model()
+        convert_to_int8_inference(model, ptq)
+        x = np.random.RandomState(3).randn(2, 3, 8, 8).astype(np.float32)
+        want = model(paddle.to_tensor(x)).numpy()
+
+        prefix = str(tmp_path / "int8net")
+        paddle.static.save_inference_model(
+            prefix, [InputSpec([2, 3, 8, 8], "float32", name="x")], model
+        )
+        # int8 constants shrink the artifact: weights are ~4x smaller than f32
+        pred = create_predictor(Config(prefix))
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(x)
+        pred.run()
+        got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
