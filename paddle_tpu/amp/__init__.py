@@ -153,7 +153,11 @@ class GradScaler:
         found = False
         for p in optimizer._parameter_list or []:
             if p.grad is not None:
-                g = p.grad._data.astype(jnp.float32) / self._scale
+                from ..core.lazy import concrete
+
+                # isfinite needs a real buffer — the arithmetic above would
+                # otherwise stay lazy and jnp.* rejects LazyArray operands
+                g = concrete(p.grad._data.astype(jnp.float32) / self._scale)
                 found = bool(found or not bool(jnp.isfinite(g).all()))
                 p.grad._set_data(g.astype(p.grad._data.dtype) if p.grad._data.dtype != jnp.float32 else g)
         self._found_inf = found

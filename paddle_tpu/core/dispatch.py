@@ -13,6 +13,7 @@ in the tracer (tracer.cc:207-221).
 """
 from __future__ import annotations
 
+import time as _time
 import weakref
 from typing import Callable, Optional, Sequence
 
@@ -22,6 +23,19 @@ import jax
 from . import lazy as lazy_mod
 from .engine import GradNode, grad_enabled
 from .tensor import Tensor
+
+# profiler module, bound once at first dispatch (module-level `from .. import`
+# would run during partial package init; per-op imports cost the hot path)
+_profiler = None
+
+
+def _prof():
+    global _profiler
+    if _profiler is None:
+        from .. import profiler
+
+        _profiler = profiler
+    return _profiler
 
 # AMP hook — set by paddle_tpu.amp.auto_cast; signature (op_name, tensors) -> tensors
 _amp_hook: Optional[Callable] = None
@@ -106,6 +120,30 @@ def eager_call(
     an array or a tuple of arrays. ``nondiff_outputs`` marks integer/bool
     output positions excluded from the vjp capture.
     """
+    p = _prof()
+    if p._enabled:
+        _t0 = _time.perf_counter_ns()
+        try:
+            return _eager_call_impl(
+                name, fn, tensor_args, attrs, differentiable,
+                nondiff_outputs, fn_key,
+            )
+        finally:
+            p._record("op::" + name, _t0)
+    return _eager_call_impl(
+        name, fn, tensor_args, attrs, differentiable, nondiff_outputs, fn_key
+    )
+
+
+def _eager_call_impl(
+    name: str,
+    fn: Callable,
+    tensor_args: Sequence[Tensor],
+    attrs: Optional[dict] = None,
+    differentiable: bool = True,
+    nondiff_outputs: Sequence[int] = (),
+    fn_key=None,
+):
     attrs = attrs or {}
     if _amp_hook is not None:
         tensor_args = _amp_hook(name, tensor_args)

@@ -187,10 +187,13 @@ def _tape_backward(roots, grad_tensors, retain_graph):
             a = arrs[k] if arrs is not None else t._data
             dn_kind = n.input_routes[k]
             if dn_kind is None:
-                j = const_ix.get(id(t))
+                # key on (tensor, captured array): a tensor mutated via
+                # _set_data between two forward uses captured two distinct
+                # arrays, and each use must replay its own value
+                j = const_ix.get((id(t), id(a)))
                 if j is None:
                     j = len(const_inputs)
-                    const_ix[id(t)] = j
+                    const_ix[(id(t), id(a))] = j
                     const_inputs.append(a)
             elif dn_kind[0] == "leaf":
                 t2 = dn_kind[1]
@@ -200,10 +203,16 @@ def _tape_backward(roots, grad_tensors, retain_graph):
                     leaf_ix[id(t2)] = j
                     diff_leaves.append(t2)
                     leaf_values.append(a)
+                elif leaf_values[j] is not a:
+                    # same differentiable leaf captured with two different
+                    # values (mutated mid-iteration): a single-value vjp
+                    # replay would be wrong — fall back to per-node engine
+                    return None
         dn = []
-        for t, route in zip(in_tensors, n.input_routes):
+        for k, (t, route) in enumerate(zip(in_tensors, n.input_routes)):
             if route is None:
-                dn.append(("c", const_ix[id(t)]))
+                a = arrs[k] if arrs is not None else t._data
+                dn.append(("c", const_ix[(id(t), id(a))]))
             elif route[0] == "node":
                 dn.append(("n", node_ix[id(route[1])], route[2]))
             else:

@@ -189,44 +189,67 @@ class LazyArray:
     def __format__(self, spec):
         return format(np.asarray(self._value()) if self.ndim else self._value().item(), spec)
 
-    def __getitem__(self, idx):
-        return self._value()[idx]
+    @staticmethod
+    def _rev(fn):
+        def rev(a, b):
+            return fn(b, a)
 
-    def _binop(self, other, op):
+        rev.__name__ = "r_" + fn.__name__
+        return rev
+
+    def __getitem__(self, idx):
+        # stay lazy for static indices (ints/slices): a stray `lazy[0]` in a
+        # library must not split the fused iteration into two executables
+        try:
+            hash(idx)
+        except TypeError:
+            return self._value()[idx]
+        (out,), _ = record(
+            "lazy_getitem", lambda a: a[idx], [self],
+            key=("lazy_getitem", str(idx)),
+        )
+        return out
+
+    # arithmetic stays LAZY (recorded into the pending graph) — raw operator
+    # use on a LazyArray must not force a full flush of the iteration
+    def _binop(self, other, op, name):
+        if _no_tracer(other):
+            return maybe_lazy_binary(op, self, other, name=name)
         return op(self._value(), other)
 
     def __add__(self, o):
-        return self._binop(o, jnp.add)
+        return self._binop(o, jnp.add, "lazy_add")
 
     def __radd__(self, o):
-        return jnp.add(o, self._value())
+        return self._binop(o, self._rev(jnp.add), "lazy_radd")
 
     def __sub__(self, o):
-        return self._binop(o, jnp.subtract)
+        return self._binop(o, jnp.subtract, "lazy_sub")
 
     def __rsub__(self, o):
-        return jnp.subtract(o, self._value())
+        return self._binop(o, self._rev(jnp.subtract), "lazy_rsub")
 
     def __mul__(self, o):
-        return self._binop(o, jnp.multiply)
+        return self._binop(o, jnp.multiply, "lazy_mul")
 
     def __rmul__(self, o):
-        return jnp.multiply(o, self._value())
+        return self._binop(o, self._rev(jnp.multiply), "lazy_rmul")
 
     def __truediv__(self, o):
-        return self._binop(o, jnp.divide)
+        return self._binop(o, jnp.divide, "lazy_div")
 
     def __rtruediv__(self, o):
-        return jnp.divide(o, self._value())
+        return self._binop(o, self._rev(jnp.divide), "lazy_rdiv")
 
     def __neg__(self):
-        return -self._value()
+        (out,), _ = record("lazy_neg", jnp.negative, [self], key=("lazy_neg",))
+        return out
 
     def __matmul__(self, o):
-        return self._value() @ o
+        return self._binop(o, jnp.matmul, "lazy_matmul")
 
     def __pow__(self, o):
-        return self._value() ** o
+        return self._binop(o, jnp.power, "lazy_pow")
 
     def __lt__(self, o):
         return self._value() < o
@@ -363,7 +386,20 @@ def flush():
         return
     _state.flushing = True
     try:
-        _flush_impl(g)
+        from .dispatch import _prof
+
+        p = _prof()
+        if p._enabled:
+            import time as _time
+
+            _t0 = _time.perf_counter_ns()
+            n = len(g.nodes)
+            try:
+                _flush_impl(g)
+            finally:
+                p._record(f"lazy::flush[{n} ops]", _t0)
+        else:
+            _flush_impl(g)
     finally:
         _state.flushing = False
 

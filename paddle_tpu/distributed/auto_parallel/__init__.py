@@ -24,39 +24,288 @@ def _axis_size(mesh, name):
     return dict(zip(mesh.axis_names, mesh.devices.shape)).get(name, 1)
 
 
+# -- sharding plans ----------------------------------------------------------
+
+class ShardingPlan:
+    """A complete parameter placement: {param_name: PartitionSpec|None}.
+    The TPU-native analogue of the reference's per-op dist-attr assignment
+    (completion.py:111 output) — GSPMD propagates through ops, so a plan
+    only has to pin the parameters (+ optional activation constraints)."""
+
+    def __init__(self, name: str, param_specs: dict):
+        self.name = name
+        self.param_specs = dict(param_specs)
+        self.score = None  # filled by select_plan
+        self.report = None
+
+    def apply(self, model):
+        for pname, p in model.named_parameters():
+            if getattr(p, "pspec", None) is not None and pname not in self.param_specs:
+                continue  # keep user annotations not covered by the plan
+            p.pspec = self.param_specs.get(pname)
+        return model
+
+    def __repr__(self):
+        n = sum(1 for s in self.param_specs.values() if s is not None)
+        return f"ShardingPlan({self.name!r}, {n} sharded params, score={self.score})"
+
+
+# Name hints for the Megatron pairing (reference dist_matmul.py column/row
+# variants are chosen per op; these cover the transformer naming conventions).
+# Col hints include the full HF/Llama forms (gate_proj/up_proj) — they must
+# win before the generic 'proj' row hint matches them.
+_COL_HINTS = ("qkv", "q_proj", "k_proj", "v_proj", "query", "key", "value",
+              "up", "up_proj", "gate", "gate_proj", "fc1", "w1", "wi",
+              "in_proj")
+_ROW_HINTS = ("down", "down_proj", "o_proj", "out_proj", "fc2", "w2", "wo",
+              "proj", "dense")
+
+
+def _classify(name: str):
+    last = name.split(".")[-2] if name.endswith((".weight", ".bias")) else name
+    last = last.lower()
+    if any(h == last or last.endswith("_" + h) or last.endswith("." + h) for h in _COL_HINTS):
+        return "col"
+    if any(h == last or last.endswith("_" + h) or last.endswith("." + h) for h in _ROW_HINTS):
+        return "row"
+    return None
+
+
+def _megatron_specs(model, mp: int, mp_axis: str) -> dict:
+    """Structure-aware Megatron placement. Per PARENT module, 2-D weights
+    pair up column→row in order (fixes the order-fragility of a global
+    alternation counter: interleaved 1-D params or sibling modules can't
+    desynchronize the pairing); explicit name hints win over position."""
+    specs = {}
+    shapes = {}
+    by_parent: dict = {}
+    for name, p in model.named_parameters():
+        shape = tuple(p.shape)
+        shapes[name] = shape
+        # vocab/position table: tall (≥4x) AND genuinely table-sized — the
+        # row floor keeps small tall Linears (e.g. 64x16) out of the branch
+        if (len(shape) == 2 and shape[0] >= 4 * shape[1] and shape[0] >= 256
+                and shape[0] % mp == 0):
+            specs[name] = P(mp_axis, None)
+            continue
+        if len(shape) != 2:
+            specs[name] = None
+            continue
+        parent = name.rsplit(".", 2)[0] if name.count(".") >= 2 else ""
+        by_parent.setdefault(parent, []).append((name, shape))
+    for parent, entries in by_parent.items():
+        # hint-classified weights shard unconditionally; UNclassified ones
+        # only pair col→row when the parent holds an even number of them
+        # (a lone unpaired weight sharded one way would force a gather with
+        # no matching partner — conservative default: replicate)
+        unclassified = [n for n, _ in entries if _classify(n) is None]
+        pair_ok = len(unclassified) >= 2 and len(unclassified) % 2 == 0
+        flip = 0
+        for name, shape in entries:
+            kind = _classify(name)
+            if kind is None:
+                if not pair_ok:
+                    specs[name] = None
+                    continue
+                kind = "col" if flip % 2 == 0 else "row"
+                flip += 1
+            if kind == "col" and shape[1] % mp == 0:
+                specs[name] = P(None, mp_axis)
+                # Megatron pairs the column weight with a SHARDED bias
+                # (mp_layers.py ColumnParallelLinear bias pspec)
+                bias = name[: -len("weight")] + "bias" if name.endswith(".weight") else None
+                if bias in shapes and len(shapes[bias]) == 1 and shapes[bias][0] % mp == 0:
+                    specs[bias] = P(mp_axis)
+            elif shape[0] % mp == 0:
+                specs[name] = P(mp_axis, None)
+            else:
+                specs[name] = None
+    return specs
+
+
+def derive_candidate_plans(model, mesh: Optional[Mesh] = None, mp_axis="mp", dp_axis="dp"):
+    """Candidate placements for an unannotated model (the plan-search space
+    the reference explores via completion+cost_model). Returns plans in
+    preference order; select_plan scores them on the actual compiled step."""
+    mesh = mesh or global_mesh()
+    mp = _axis_size(mesh, mp_axis)
+    names = [n for n, _ in model.named_parameters()]
+    # user shard_tensor annotations overlay EVERY candidate (they are
+    # constraints on the search, exactly like reference completion treats
+    # partial user dist-attrs)
+    user = {n: p.pspec for n, p in model.named_parameters()
+            if getattr(p, "pspec", None) is not None}
+
+    def with_user(specs):
+        specs = dict(specs)
+        specs.update(user)
+        return specs
+
+    plans = [ShardingPlan("replicated", with_user({n: None for n in names}))]
+    if mp > 1:
+        plans.insert(0, ShardingPlan(
+            "megatron", with_user(_megatron_specs(model, mp, mp_axis))
+        ))
+        # embedding-only: shard just the big tables (bandwidth-bound models)
+        emb = {}
+        for n, p in model.named_parameters():
+            shape = tuple(p.shape)
+            if (len(shape) == 2 and shape[0] >= 4 * shape[1]
+                    and shape[0] >= 256 and shape[0] % mp == 0):
+                emb[n] = P(mp_axis, None)
+            else:
+                emb[n] = None
+        if any(s is not None for s in emb.values()):
+            plans.append(ShardingPlan("embedding-only", with_user(emb)))
+    return plans
+
+
 def complete_annotations(model, mesh: Optional[Mesh] = None, mp_axis="mp", dp_axis="dp"):
     """Assign PartitionSpecs to every un-annotated parameter (reference
-    completion.py:111 — here a placement pass instead of per-op dist-attr
-    inference, because GSPMD owns op propagation).
-
-    Heuristic (the Megatron pattern the reference's completion converges to):
-      * embeddings (first dim = vocab-like, >= 4x second) -> shard dim 0;
-      * consecutive 2-D weights alternate column/row sharding over ``mp``;
-      * 1-D params (bias/scale) stay replicated;
-      * anything already annotated (user ``shard_tensor``) is kept.
-    """
+    completion.py:111 — a placement pass instead of per-op dist-attr
+    inference, because GSPMD owns op propagation). Applies the structure-
+    aware Megatron plan; Engine.prepare(auto=True) additionally scores the
+    candidate plans on the compiled step and keeps the cheapest."""
     mesh = mesh or global_mesh()
     mp = _axis_size(mesh, mp_axis)
     if mp <= 1:
         return model
-    flip = 0
-    for name, p in model.named_parameters():
-        if getattr(p, "pspec", None) is not None:
-            continue
-        shape = tuple(p.shape)
-        if len(shape) < 2:
-            continue
-        if shape[0] >= 4 * shape[1] and shape[0] % mp == 0:  # embedding-like
-            p.pspec = P(mp_axis, None)
-            continue
-        if len(shape) == 2:
-            # alternate column (out-dim) / row (in-dim) sharding
-            if flip % 2 == 0 and shape[1] % mp == 0:
-                p.pspec = P(None, mp_axis)
-            elif shape[0] % mp == 0:
-                p.pspec = P(mp_axis, None)
-            flip += 1
+    user = {n: p.pspec for n, p in model.named_parameters()
+            if getattr(p, "pspec", None) is not None}
+    specs = _megatron_specs(model, mp, mp_axis)
+    specs.update(user)  # user annotations always win
+    ShardingPlan("megatron", specs).apply(model)
     return model
+
+
+# -- reshard -----------------------------------------------------------------
+
+def reshard(x, placement, mesh: Optional[Mesh] = None):
+    """Pin a value (Tensor or array, eager or traced) to a sharding — the
+    reference's reshard pass (reshard.py:1) inserts send/recv between
+    incompatibly-sharded producer/consumer; under GSPMD the same capability
+    is a sharding constraint and XLA inserts the collective."""
+    mesh = mesh or global_mesh()
+    spec = placement if isinstance(placement, P) else P(*placement)
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    if isinstance(x, Tensor):
+        from ...core.lazy import concrete as _conc
+
+        arr = x._data
+        if isinstance(arr, jax.core.Tracer):
+            return Tensor(jax.lax.with_sharding_constraint(arr, sharding),
+                          stop_gradient=x.stop_gradient)
+        return Tensor(jax.device_put(_conc(arr), sharding),
+                      stop_gradient=x.stop_gradient)
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(x, sharding)
+
+
+# -- cost model / plan selection ---------------------------------------------
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+
+def analyze_collectives(hlo_text: str):
+    """Count communication ops and bytes in a compiled (post-SPMD) HLO
+    module. The comm half of the cost model the reference builds op tables
+    for (auto_parallel/cost_model.py)."""
+    import re
+
+    counts = {c: 0 for c in _COLLECTIVES}
+    total_bytes = 0.0
+    shape_re = re.compile(r"([a-z]+[0-9]+|pred)\[([0-9,]*)\]")
+    for line in hlo_text.splitlines():
+        m = re.search(r"=\s*(\([^)]*\)|\S+)\s+([a-z\-]+)", line)
+        if not m:
+            continue
+        op = m.group(2)
+        base = op[:-6] if op.endswith("-start") else op
+        if base not in counts:
+            continue
+        if op.endswith("-done"):
+            continue
+        counts[base] += 1
+        out_part = line.split("=", 1)[1].split(base)[0]
+        for dt, dims in shape_re.findall(out_part):
+            numel = 1
+            for d in dims.split(","):
+                if d:
+                    numel *= int(d)
+            total_bytes += numel * _DTYPE_BYTES.get(dt, 4)
+    counts = {k: v for k, v in counts.items() if v}
+    return {"counts": counts, "bytes": total_bytes}
+
+
+# Roofline constants (v5e class) — only RATIOS matter for ranking plans.
+_PEAK_FLOPS = 197e12
+_HBM_BW = 819e9
+_ICI_BW = 90e9
+
+
+def plan_cost(compiled) -> dict:
+    """Roofline score of one compiled per-device program: compute time +
+    HBM time + ICI time (+ peak memory for budget checks)."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    flops = float(cost.get("flops", 0.0))
+    bytes_acc = float(cost.get("bytes accessed", cost.get("bytes_accessed", 0.0)))
+    comm = analyze_collectives(compiled.as_text())
+    peak = 0
+    try:
+        mem = compiled.memory_analysis()
+        peak = int(getattr(mem, "temp_size_in_bytes", 0)) + int(
+            getattr(mem, "output_size_in_bytes", 0)
+        ) + int(getattr(mem, "argument_size_in_bytes", 0))
+    except Exception:
+        pass
+    t = flops / _PEAK_FLOPS + bytes_acc / _HBM_BW + comm["bytes"] / _ICI_BW
+    return {
+        "time_proxy": t, "flops": flops, "bytes_accessed": bytes_acc,
+        "comm_bytes": comm["bytes"], "comm_counts": comm["counts"],
+        "peak_memory_bytes": peak,
+    }
+
+
+def select_plan(model, plans, build_compiled, memory_budget: Optional[int] = None):
+    """Score each candidate plan on its ACTUAL compiled train step and apply
+    the best (reference: completion candidates ranked by cost_model).
+
+    ``build_compiled()`` must compile the current placement and return the
+    jax Compiled object (e.g. engine._jit.lower(*args).compile()).
+    Plans over the memory budget are rejected; ties break on comm bytes."""
+    original = {n: getattr(p, "pspec", None) for n, p in model.named_parameters()}
+    best = None
+    for plan in plans:
+        plan.apply(model)
+        try:
+            compiled = build_compiled()
+            rep = plan_cost(compiled)
+        except Exception as e:  # unshardable plan (bad divisibility, …)
+            plan.report = {"error": str(e)[:200]}
+            continue
+        plan.report = rep
+        over = memory_budget is not None and rep["peak_memory_bytes"] > memory_budget
+        plan.score = (1 if over else 0, rep["time_proxy"], rep["comm_bytes"])
+        if best is None or plan.score < best.score:
+            best = plan
+    if best is None:
+        # leave the model exactly as the caller annotated it, not with the
+        # last failed candidate's pspecs
+        for n, p in model.named_parameters():
+            p.pspec = original[n]
+        raise RuntimeError("no candidate sharding plan compiled successfully")
+    best.apply(model)
+    return best
 
 
 def estimate_cost(fn: Callable, *example_args, mesh: Optional[Mesh] = None):
@@ -108,6 +357,42 @@ class Engine:
         self._engine = HybridParallelEngine(self.model, self.optimizer, wrapped, mesh=self.mesh)
         return self
 
+    def auto_parallelize(self, *example_batch, memory_budget=None):
+        """Full auto-parallel: derive candidate plans, compile each one's
+        train step, score (roofline compute + HBM + ICI comm, peak memory
+        budget), apply the winner (reference completion+partitioner+reshard
+        +cost_model loop, GSPMD-first). Returns the winning ShardingPlan."""
+        from ..engine import HybridParallelEngine
+        from ...core import random as random_state
+
+        loss_fn = self.loss
+
+        def wrapped(model, *batch):
+            out = loss_fn(model(*batch[:-1]), batch[-1]) if loss_fn else model(*batch)
+            return out
+
+        plans = derive_candidate_plans(self.model, self.mesh)
+        batch_t = [b if isinstance(b, Tensor) else Tensor(np.asarray(b)) for b in example_batch]
+
+        def build_compiled():
+            st = random_state._get()
+            saved_key = st.key
+            try:
+                eng = HybridParallelEngine(
+                    self.model, self.optimizer, wrapped, mesh=self.mesh, donate=False
+                )
+                args = eng._prepare(*batch_t)
+                return eng._jit.lower(*args).compile()
+            finally:
+                st.key = saved_key
+
+        best = select_plan(self.model, plans, build_compiled, memory_budget)
+        self._engine = HybridParallelEngine(
+            self.model, self.optimizer, wrapped, mesh=self.mesh
+        )
+        self.plan = best
+        return best
+
     def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None, **k):
         if self._engine is None:
             self.prepare()
@@ -142,4 +427,8 @@ class Engine:
         return {"flops": float(cost.get("flops", 0.0))}
 
 
-__all__ = ["Engine", "complete_annotations", "estimate_cost"]
+__all__ = [
+    "Engine", "ShardingPlan", "analyze_collectives", "complete_annotations",
+    "derive_candidate_plans", "estimate_cost", "plan_cost", "reshard",
+    "select_plan",
+]

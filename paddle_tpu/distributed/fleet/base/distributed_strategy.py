@@ -53,7 +53,9 @@ class DistributedStrategy:
         self.lars = False
         self.lars_configs = {}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "sparsity": (0.999,)}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 4}
         self.fp16_allreduce = False
         self.a_sync = False
         self.a_sync_configs = {}

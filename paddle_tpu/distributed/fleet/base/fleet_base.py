@@ -92,7 +92,40 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """Reference fleet_base.py:839 → HybridParallelOptimizer."""
+    """Reference fleet_base.py:839 → HybridParallelOptimizer; the localsgd /
+    dgc strategy flags wrap the inner optimizer first (reference composes
+    them as meta-optimizers via strategy_compiler.py)."""
     from ..meta_optimizers.hybrid_parallel_optimizer import HybridParallelOptimizer
 
-    return HybridParallelOptimizer(optimizer, _hcg, strategy or _get_strategy())
+    strategy = strategy or _get_strategy()
+    # DGC wraps FIRST (it replaces the update rule of the raw Momentum/SGD);
+    # LocalSGD composes on top by delegating step() — so localsgd+dgc works
+    if getattr(strategy, "dgc", False):
+        from ..meta_optimizers.dgc_optimizer import DGCMomentumOptimizer
+
+        # the reference restricts DGC to Momentum (dgc_optimizer.py asserts
+        # the inner type); silently replacing e.g. AdamW's update rule with
+        # momentum SGD would be a correctness surprise
+        tname = type(optimizer).__name__
+        if tname not in ("Momentum", "SGD", "DGCMomentumOptimizer"):
+            raise ValueError(
+                f"strategy.dgc requires a Momentum/SGD inner optimizer "
+                f"(got {tname}); DGC replaces the update rule with "
+                "compressed momentum SGD"
+            )
+        cfg = getattr(strategy, "dgc_configs", {}) or {}
+        if tname != "DGCMomentumOptimizer":
+            optimizer = DGCMomentumOptimizer(
+                learning_rate=optimizer.get_lr(),
+                lr_fn=optimizer.get_lr,  # live: LR schedulers keep working
+                momentum=getattr(optimizer, "_momentum", 0.9),
+                parameters=optimizer._parameter_list,
+                rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                sparsity=cfg.get("sparsity", (0.999,)),
+            )
+    if getattr(strategy, "localsgd", False):
+        from ..meta_optimizers.localsgd_optimizer import LocalSGDOptimizer
+
+        cfg = getattr(strategy, "localsgd_configs", {}) or {}
+        optimizer = LocalSGDOptimizer(optimizer, k_steps=cfg.get("k_steps", 4))
+    return HybridParallelOptimizer(optimizer, _hcg, strategy)

@@ -1,0 +1,103 @@
+"""DGC — Deep Gradient Compression momentum optimizer (reference
+fleet/meta_optimizers/dgc_optimizer.py:1 + operators/optimizers/
+dgc_momentum_op; Lin et al. 2018).
+
+Semantics kept from the reference: per-parameter velocity u and
+error-feedback accumulator v; each step u = m·u + g, v += u; only the top
+(1 − sparsity) fraction of |v| is COMMUNICATED and applied, the rest stays
+in v (error feedback) with momentum-factor masking on u; a ramp-up window
+trains dense. TPU-native adaptation: the "communicated sparse gradient" is
+the masked dense tensor pmean-ed over the dp axis when traced — ICI
+all-reduce of a mostly-zero dense tensor replaces the reference's
+sparse-index NCCL path (XLA has no sparse collective; the SEMANTIC
+compression — what gets applied vs. accumulated — is identical).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....core.lazy import concrete as _concrete
+
+__all__ = ["DGCMomentumOptimizer"]
+
+
+class DGCMomentumOptimizer:
+    """Momentum SGD with top-k gradient compression + error feedback."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
+                 group=None, axis_name=None, grad_clip=None, name=None,
+                 lr_fn=None):
+        # lr_fn: live getter (e.g. inner_optimizer.get_lr) so an attached LR
+        # scheduler keeps working instead of freezing at the wrap-time value
+        self._lr_fn = lr_fn
+        self._lr = float(learning_rate() if callable(learning_rate) else learning_rate)
+        self._momentum = float(momentum)
+        self._parameter_list = list(parameters) if parameters is not None else []
+        self._rampup_begin = int(rampup_begin_step)
+        self._sparsity = tuple(sparsity) if isinstance(sparsity, (list, tuple)) else (float(sparsity),)
+        self.axis_name = axis_name or (group.axis_name if group is not None else "dp")
+        self._step_count = 0
+        self._u = {}  # id(param) -> velocity
+        self._v = {}  # id(param) -> error-feedback accumulator
+        # observability: fraction of elements communicated last step
+        self.last_comm_fraction = 1.0
+
+    def get_lr(self):
+        return float(self._lr_fn()) if self._lr_fn is not None else self._lr
+
+    def set_lr(self, lr):
+        self._lr = float(lr)
+
+    def _pmean(self, arr):
+        if isinstance(arr, jax.core.Tracer):
+            return lax.pmean(arr, self.axis_name)
+        return arr
+
+    def step(self):
+        lr = self.get_lr()
+        sparsity = self._sparsity[min(len(self._sparsity) - 1, max(0, self._step_count - self._rampup_begin))] \
+            if self._step_count >= self._rampup_begin else None
+        total = kept = 0
+        for p in self._parameter_list:
+            if p.grad is None or p.stop_gradient:
+                continue
+            g = p.grad._data
+            key = id(p)
+            if self._step_count < self._rampup_begin:
+                # dense ramp-up: plain distributed momentum
+                g = self._pmean(g)
+                u = self._momentum * self._u.get(key, jnp.zeros_like(g)) + g
+                self._u[key] = u
+                p._set_data(p._data - lr * u)
+                continue
+            u = self._momentum * self._u.get(key, jnp.zeros_like(g)) + g
+            v = self._v.get(key, jnp.zeros_like(g)) + u
+            k = max(1, int(round(v.size * (1.0 - sparsity))))
+            absv = jnp.abs(v).ravel()
+            thr = lax.top_k(absv, k)[0][-1]
+            mask = jnp.abs(v) >= thr
+            send = jnp.where(mask, v, 0)
+            # momentum-factor masking + error feedback (Lin et al. §3.2)
+            self._v[key] = jnp.where(mask, 0, v)
+            self._u[key] = jnp.where(mask, 0, u)
+            send = self._pmean(send)
+            p._set_data(p._data - lr * send)
+            total += v.size
+            kept += int(k)
+        if total:
+            self.last_comm_fraction = kept / total
+        self._step_count += 1
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    def state_dict(self):
+        return {
+            "step": self._step_count,
+            "u": {i: _concrete(a) for i, a in enumerate(self._u.values())},
+            "v": {i: _concrete(a) for i, a in enumerate(self._v.values())},
+        }

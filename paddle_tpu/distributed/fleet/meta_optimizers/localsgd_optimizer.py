@@ -1,0 +1,65 @@
+"""LocalSGD (reference fleet/meta_optimizers/localsgd_optimizer.py:1):
+every worker takes k local optimizer steps, then parameters are averaged
+across the data-parallel group. The reference rewrites the static program to
+insert c_allreduce on params every k steps; TPU-native, the sync is a pmean
+on the dp mesh axis inside the traced step (or a device_put-mean eagerly),
+and the wrapper composes with any inner optimizer.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from ....core.tensor import Tensor
+
+__all__ = ["LocalSGDOptimizer"]
+
+
+class LocalSGDOptimizer:
+    """Wrap an inner optimizer with k-step local training + param averaging.
+
+    Inside a shard_map/pmap-traced step the sync is ``lax.pmean`` over the
+    group's mesh axis; eagerly (single replica) it is a no-op — matching the
+    reference's behavior where LocalSGD only alters multi-worker runs.
+    """
+
+    def __init__(self, inner, k_steps: int = 4, group=None, axis_name=None):
+        self.inner = inner
+        self.k_steps = max(int(k_steps), 1)
+        self.group = group
+        self.axis_name = axis_name or (group.axis_name if group is not None else "dp")
+        self._local_steps = 0
+
+    # -- delegation --------------------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    @property
+    def _parameter_list(self):
+        return self.inner._parameter_list
+
+    def step(self):
+        self.inner.step()
+        self._local_steps += 1
+        if self._local_steps % self.k_steps == 0:
+            self.sync_params()
+
+    def sync_params(self):
+        """Average parameters across the dp axis (the reference's inserted
+        c_allreduce(param)/nranks block)."""
+        for p in self.inner._parameter_list or []:
+            arr = p._data
+            if isinstance(arr, jax.core.Tracer):
+                p._set_data(lax.pmean(arr, self.axis_name))
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        st = self.inner.state_dict()
+        st["@local_steps"] = self._local_steps
+        return st
+
+    def set_state_dict(self, st):
+        self._local_steps = st.pop("@local_steps", 0)
+        self.inner.set_state_dict(st)

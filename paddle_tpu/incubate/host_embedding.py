@@ -32,7 +32,72 @@ from ..core.lazy import concrete as _concrete
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
-__all__ = ["HostEmbeddingTable", "HostEmbedding"]
+__all__ = [
+    "HostEmbeddingTable", "HostEmbedding", "ShardedHostEmbeddingTable",
+    "sharded_host_embedding",
+]
+
+
+def sharded_host_embedding(num_embeddings, embedding_dim, store=None, **kw):
+    """Fleet-integrated constructor: build a HostEmbedding whose table is
+    sharded across the trainer processes of the current fleet job (reads
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM; rendezvous through the given
+    TCPStore or one bootstrapped from PADDLE_EMB_STORE_PORT). Single-process
+    jobs fall back to a plain host table — same code path either way, like
+    ``the_one_ps.py`` switching between local and distributed tables."""
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if world <= 1:
+        return HostEmbedding(num_embeddings, embedding_dim, **kw)
+    if store is None:
+        from ..core.native import TCPStore
+
+        host = os.environ.get("PADDLE_EMB_STORE_HOST", "127.0.0.1")
+        port = int(os.environ.get("PADDLE_EMB_STORE_PORT", "23461"))
+        store = TCPStore(host=host, port=port, is_master=(rank == 0))
+    table = ShardedHostEmbeddingTable(
+        num_embeddings, embedding_dim, store=store, rank=rank, world_size=world,
+        optimizer=kw.pop("optimizer", "sgd"), init_std=kw.pop("init_std", 0.01),
+        seed=kw.pop("seed", 0), path=kw.pop("path", None),
+    )
+    return HostEmbedding(num_embeddings, embedding_dim, table=table)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer (counter-based hashing RNG core)."""
+    with np.errstate(over="ignore"):
+        z = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+
+def _merge_sparse_grads(ids_list, grads_list, dim: int):
+    """Coalesce sparse grad pushes: concatenate, merge duplicate ids by
+    SUMMING their rows. Returns (unique_ids, merged_grads)."""
+    cat_ids = np.concatenate(ids_list) if ids_list else np.empty((0,), np.int64)
+    if cat_ids.size == 0:
+        return cat_ids, np.empty((0, dim), np.float32)
+    cat_grads = np.concatenate(grads_list, axis=0)
+    uniq, inv = np.unique(cat_ids, return_inverse=True)
+    if uniq.size == cat_ids.size:  # no duplicates: reorder only
+        return uniq, cat_grads[np.argsort(cat_ids, kind="stable")]
+    merged = np.zeros((uniq.size, dim), np.float32)
+    np.add.at(merged, inv, cat_grads)
+    return uniq, merged
+
+
+def _hash_normal_rows(rows: np.ndarray, dim: int, seed: int, std: float) -> np.ndarray:
+    """N(0, std) values for the given row ids, deterministic per (row, col):
+    splitmix64 counters → two uniforms → Box–Muller. Fully vectorized."""
+    idx = rows.astype(np.uint64)[:, None] * np.uint64(dim) + np.arange(dim, dtype=np.uint64)[None, :]
+    with np.errstate(over="ignore"):
+        h1 = _splitmix64(idx ^ np.uint64(seed & 0xFFFFFFFFFFFFFFFF))
+        h2 = _splitmix64(h1)
+    # top 53 bits → uniform in (0, 1]; u1 kept away from 0 for the log
+    u1 = ((h1 >> np.uint64(11)).astype(np.float64) + 1.0) / 9007199254740993.0
+    u2 = (h2 >> np.uint64(11)).astype(np.float64) / 9007199254740992.0
+    return (std * np.sqrt(-2.0 * np.log(u1)) * np.cos(2.0 * np.pi * u2)).astype(np.float32)
 
 
 class HostEmbeddingTable:
@@ -83,12 +148,17 @@ class HostEmbeddingTable:
         self._initialized = np.zeros(self.num_embeddings, bool)
 
     def _ensure_init(self, ids: np.ndarray):
-        fresh = ids[~self._initialized[ids]]
+        fresh = np.unique(ids[~self._initialized[ids]])
         if fresh.size == 0:
             return
-        for r in fresh:
-            rng = np.random.default_rng(self.seed * 0x9E3779B1 + int(r))
-            self.table[r] = rng.normal(0.0, self.init_std, self.embedding_dim).astype(self.dtype)
+        # vectorized counter-based init (one splitmix64+Box-Muller pass over
+        # the whole fresh block): a cold batch with 50k new ids costs two
+        # numpy kernels, not 50k python RNG constructions — and stays
+        # deterministic PER ROW, so values don't depend on touch order or on
+        # how the table is sharded across processes
+        self.table[fresh] = _hash_normal_rows(
+            fresh, self.embedding_dim, self.seed, self.init_std
+        ).astype(self.dtype)
         self._initialized[fresh] = True
 
     def gather(self, ids: np.ndarray) -> np.ndarray:
@@ -121,6 +191,135 @@ class HostEmbeddingTable:
         return self.table.nbytes
 
 
+class ShardedHostEmbeddingTable:
+    """Embedding table SHARDED BY ID across processes (id % world == owner),
+    with pull/push over the native TCPStore — the distributed capability of
+    the reference's brpc PS (``memory_sparse_table.cc`` shards by feature
+    hash across servers; ``the_one_ps.py:606`` wires pull/push into train).
+    Every rank is both worker and server: a gather is a collective exchange
+    (all ranks request → serve owned rows → read replies), a push routes
+    grads to the owners, which merge duplicate ids and apply ONE sparse
+    update — sync-PS semantics, deterministic regardless of sharding.
+
+    Transport chunks rows through the store in ≤512 KB messages; per-row
+    deterministic lazy init means a row's value is identical no matter which
+    shard materializes it.
+    """
+
+    CHUNK = 512 * 1024
+
+    def __init__(self, num_embeddings, embedding_dim, store, rank, world_size,
+                 dtype="float32", path=None, init_std=0.01, seed=0,
+                 optimizer="sgd", adagrad_eps=1e-8):
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.store = store
+        # local shard holds global ids {rank, rank+world, rank+2*world, …}
+        n_local = (self.num_embeddings - self.rank + self.world_size - 1) // self.world_size
+        self.local = HostEmbeddingTable(
+            n_local, embedding_dim, dtype=dtype, path=path,
+            init_std=init_std, seed=seed, optimizer=optimizer,
+            adagrad_eps=adagrad_eps,
+        )
+        # per-row determinism across shardings: local row i is global id
+        # i*world+rank, so init must hash the GLOBAL id
+        self.local._ensure_init = self._ensure_init_local  # type: ignore
+        self._seed = int(seed)
+        self._std = float(init_std)
+        self._gen = 0
+
+    def _ensure_init_local(self, local_ids: np.ndarray):
+        t = self.local
+        fresh = np.unique(local_ids[~t._initialized[local_ids]])
+        if fresh.size == 0:
+            return
+        global_ids = fresh * self.world_size + self.rank
+        t.table[fresh] = _hash_normal_rows(
+            global_ids, t.embedding_dim, self._seed, self._std
+        ).astype(t.dtype)
+        t._initialized[fresh] = True
+
+    # -- store transport ---------------------------------------------------
+    def _put(self, key: str, payload: bytes):
+        n = (len(payload) + self.CHUNK - 1) // self.CHUNK or 1
+        for i in range(n):
+            self.store.set(f"{key}/{i}", payload[i * self.CHUNK:(i + 1) * self.CHUNK])
+        self.store.set(key + "/n", str(n))
+
+    def _take(self, key: str) -> bytes:
+        n = int(self.store.wait(key + "/n"))
+        parts = [self.store.wait(f"{key}/{i}") for i in range(n)]
+        for i in range(n):
+            self.store.delete_key(f"{key}/{i}")
+        self.store.delete_key(key + "/n")
+        return b"".join(parts)
+
+    # -- collective pull ---------------------------------------------------
+    def gather(self, ids: np.ndarray) -> np.ndarray:
+        """Pull rows for (globally) unique ids; COLLECTIVE — every rank must
+        call this the same number of times (data-parallel lockstep, like the
+        reference's synchronous PS pull)."""
+        ids = np.asarray(ids, np.int64)
+        gen = self._gen
+        self._gen += 1
+        owner = ids % self.world_size
+        out = np.empty((ids.size, self.embedding_dim), np.float32)
+        # 1. send requests (own ids resolve locally)
+        for o in range(self.world_size):
+            if o == self.rank:
+                continue
+            want = ids[owner == o]
+            self._put(f"he/{gen}/req/{self.rank}/{o}", want.tobytes())
+        mine = ids[owner == self.rank]
+        if mine.size:
+            out[owner == self.rank] = self.local.gather(mine // self.world_size)
+        # 2. serve every other rank's request against the local shard
+        for r in range(self.world_size):
+            if r == self.rank:
+                continue
+            req = np.frombuffer(self._take(f"he/{gen}/req/{r}/{self.rank}"), np.int64)
+            rows = self.local.gather(req // self.world_size) if req.size else np.empty((0, self.embedding_dim), np.float32)
+            self._put(f"he/{gen}/rep/{self.rank}/{r}", np.ascontiguousarray(rows, np.float32).tobytes())
+        # 3. read replies
+        for o in range(self.world_size):
+            if o == self.rank:
+                continue
+            rows = np.frombuffer(self._take(f"he/{gen}/rep/{o}/{self.rank}"), np.float32)
+            out[owner == o] = rows.reshape(-1, self.embedding_dim)
+        return out
+
+    # -- collective push ---------------------------------------------------
+    def apply_update(self, ids: np.ndarray, grad: np.ndarray, lr: float):
+        """Push sparse grads to their owners; owners merge duplicates across
+        ranks (sum, like gradient accumulation) then apply ONE update."""
+        ids = np.asarray(ids, np.int64)
+        grad = np.asarray(grad, np.float32)
+        gen = self._gen
+        self._gen += 1
+        owner = ids % self.world_size
+        for o in range(self.world_size):
+            if o == self.rank:
+                continue
+            sel = owner == o
+            self._put(f"he/{gen}/gid/{self.rank}/{o}", ids[sel].tobytes())
+            self._put(f"he/{gen}/g/{self.rank}/{o}", np.ascontiguousarray(grad[sel]).tobytes())
+        all_ids = [ids[owner == self.rank]]
+        all_grads = [grad[owner == self.rank]]
+        for r in range(self.world_size):
+            if r == self.rank:
+                continue
+            gi = np.frombuffer(self._take(f"he/{gen}/gid/{r}/{self.rank}"), np.int64)
+            gg = np.frombuffer(self._take(f"he/{gen}/g/{r}/{self.rank}"), np.float32).reshape(-1, self.embedding_dim)
+            all_ids.append(gi)
+            all_grads.append(gg)
+        uniq, merged = _merge_sparse_grads(all_ids, all_grads, self.embedding_dim)
+        if uniq.size == 0:
+            return
+        self.local.apply_update(uniq // self.world_size, merged, lr)
+
+
 class HostEmbedding(Layer):
     """Embedding layer over a HostEmbeddingTable.
 
@@ -130,19 +329,61 @@ class HostEmbedding(Layer):
     the PS push / SelectedRows optimizer)."""
 
     def __init__(self, num_embeddings, embedding_dim, path=None, optimizer="sgd",
-                 init_std=0.01, seed=0, sparse=True, name=None):
+                 init_std=0.01, seed=0, sparse=True, name=None, table=None):
         super().__init__()
-        self.table = HostEmbeddingTable(
+        # table=ShardedHostEmbeddingTable(...) makes this layer the worker
+        # side of a multi-process PS (fleet wires this up from env)
+        self.table = table or HostEmbeddingTable(
             num_embeddings, embedding_dim, path=path, optimizer=optimizer,
             init_std=init_std, seed=seed,
         )
         self._pending = []  # (unique_ids, rows_tensor) awaiting push
+        self._prefetched = None  # (uniq_key_bytes, rows ndarray, thread)
+        import threading
+
+        # one lock serializes table reads (prefetch thread) against the
+        # sparse updates (apply_gradients) — torn rows are silent corruption
+        self._table_lock = threading.Lock()
+
+    def prefetch(self, x):
+        """Start the host gather for the NEXT batch on a worker thread so it
+        overlaps the current device step (the reference's PS prefetch /
+        buffered pull). forward() consumes the result when ids match.
+
+        No-op on a SHARDED table: its gather is a lockstep collective across
+        ranks, and an extra/mismatched gather from a background thread would
+        desynchronize the exchange protocol."""
+        import threading
+
+        if isinstance(self.table, ShardedHostEmbeddingTable):
+            return
+        ids = np.asarray(x._data if isinstance(x, Tensor) else x).astype(np.int64)
+        uniq = np.unique(ids.ravel())
+        slot = {"key": uniq.tobytes(), "rows": None}
+
+        def work():
+            with self._table_lock:
+                slot["rows"] = self.table.gather(uniq)
+
+        th = threading.Thread(target=work, daemon=True)
+        th.start()
+        self._prefetched = (slot, th)
+
+    def _gather(self, uniq: np.ndarray) -> np.ndarray:
+        if self._prefetched is not None:
+            slot, th = self._prefetched
+            th.join()
+            self._prefetched = None
+            if slot["key"] == uniq.tobytes():
+                return slot["rows"]
+        with self._table_lock:
+            return self.table.gather(uniq)
 
     def forward(self, x):
         xt = as_tensor(x)
         ids = np.asarray(_concrete(xt._data)).astype(np.int64)
         uniq, inverse = np.unique(ids.ravel(), return_inverse=True)
-        rows = Tensor(jnp.asarray(self.table.gather(uniq)), stop_gradient=False)
+        rows = Tensor(jnp.asarray(self._gather(uniq)), stop_gradient=False)
         if self.training:
             self._pending.append((uniq, rows))
         inv = Tensor(jnp.asarray(inverse.reshape(ids.shape)))
@@ -155,11 +396,31 @@ class HostEmbedding(Layer):
         return out
 
     def apply_gradients(self, lr: float):
-        """Push: apply accumulated sparse grads to the host table."""
+        """Push: apply accumulated sparse grads to the host table. Pending
+        microbatches are COALESCED first — duplicate ids across microbatches
+        merge into one row update (one gather/scatter on the table, and for
+        the sharded table one pull/push round instead of one per microbatch)."""
+        ids_list, grad_list = [], []
         for uniq, rows in self._pending:
             if rows.grad is not None:
-                self.table.apply_update(uniq, np.asarray(_concrete(rows.grad._data)), lr)
+                ids_list.append(uniq)
+                grad_list.append(np.asarray(_concrete(rows.grad._data), np.float32))
         self._pending = []
+        sharded = isinstance(self.table, ShardedHostEmbeddingTable)
+        if not ids_list and not sharded:
+            return
+        # a SHARDED push is a lockstep collective: a rank with nothing to
+        # push must still participate (empty payload), or peers deadlock in
+        # store.wait() and the _gen counters diverge
+        dim = self.table.embedding_dim
+        uniq, merged = _merge_sparse_grads(ids_list, grad_list, dim)
+        if uniq.size == 0 and not sharded:
+            return
+        with self._table_lock:
+            self.table.apply_update(uniq, merged, lr)
+        # rows prefetched BEFORE this update are stale now (frequent ids
+        # recur batch-to-batch); drop them so forward re-gathers fresh rows
+        self._prefetched = None
 
     def embedding_dim(self):
         return self.table.embedding_dim

@@ -277,7 +277,14 @@ def _numpy_collate(batch):
     from numpy, never CUDA)."""
     sample = batch[0]
     if isinstance(sample, Tensor):
-        return np.stack([np.asarray(s._data) for s in batch])
+        # converting would call into the inherited PJRT client inside the
+        # forked child — the exact hazard this worker path exists to avoid
+        raise TypeError(
+            "Dataset.__getitem__ returned a paddle Tensor but num_workers>0 "
+            "uses forked worker processes, which must not touch the device "
+            "runtime. Return numpy arrays (or python scalars) from "
+            "__getitem__, or pass use_shared_memory=False for thread workers."
+        )
     if isinstance(sample, np.ndarray):
         return np.stack(batch)
     if isinstance(sample, (int, np.integer)):
@@ -349,8 +356,21 @@ class _MultiprocessIter:
         if self._next >= self.n_batches:
             self._shutdown()
             raise StopIteration
+        import queue as _queue
+
         while self._next not in self._hold:
-            i, kind, payload = self.data_q.get()
+            try:
+                i, kind, payload = self.data_q.get(timeout=5.0)
+            except _queue.Empty:
+                # a crashed worker (OOM-kill, segfault) never posts its batch;
+                # without this check the consumer would block forever
+                if not any(w.is_alive() for w in self.workers):
+                    self._shutdown()
+                    raise RuntimeError(
+                        "DataLoader workers exited unexpectedly before "
+                        f"producing batch {self._next}/{self.n_batches}"
+                    )
+                continue
             self._hold[i] = (kind, payload)
         kind, payload = self._hold.pop(self._next)
         self._next += 1
@@ -517,6 +537,7 @@ class DataLoader:
         timeout=0,
         worker_init_fn=None,
         persistent_workers=False,
+        use_multiprocess=None,
     ):
         self.dataset = dataset
         self.return_list = return_list
@@ -525,8 +546,12 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
         # worker PROCESSES (reference default: GIL-free preprocessing via
-        # dataloader_iter.py:326 fork+shared-memory); False → thread workers
-        self.use_multiprocess = use_shared_memory
+        # dataloader_iter.py:326 fork+shared-memory); False → thread workers.
+        # use_multiprocess overrides explicitly; otherwise follow
+        # use_shared_memory for reference-signature compatibility.
+        if use_multiprocess is None:
+            use_multiprocess = use_shared_memory
+        self.use_multiprocess = use_multiprocess
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
@@ -566,3 +591,8 @@ def data_home():
     return os.environ.get(
         "PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu/datasets")
     )
+
+
+# Variable-length batching (SURVEY §7 hard part (c)) — imported at the end:
+# ragged.py imports Sampler from this module.
+from .ragged import BucketSampler, bucket_boundaries, pad_to_bucket_collate  # noqa: E402,F401

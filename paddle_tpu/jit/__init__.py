@@ -16,6 +16,7 @@ ProgramDesc + params, but the artifact is an AOT-compilable module.
 from __future__ import annotations
 
 import functools
+import json
 import os
 import pickle
 from typing import Callable, Optional, Sequence
@@ -301,6 +302,20 @@ class CompiledTrainStep:
         self._jit = jax.jit(step_fn, donate_argnums=donate)
 
     def __call__(self, *batch):
+        from ..core.dispatch import _prof
+
+        p = _prof()
+        if p._enabled:
+            import time as _time
+
+            _t0 = _time.perf_counter_ns()
+            try:
+                return self._call_impl(*batch)
+            finally:
+                p._record("jit::train_step", _t0)
+        return self._call_impl(*batch)
+
+    def _call_impl(self, *batch):
         if self._jit is None:
             self._build()
         batch_arrays = tuple(_conc(b._data) if isinstance(b, Tensor) else jnp.asarray(b) for b in batch)
@@ -419,6 +434,58 @@ def save(layer, path, input_spec=None, **configs):
     from ..framework.io import save as fsave
 
     fsave({"state": {k: Tensor(v) for k, v in state.items()}, "specs": [(list(s.shape), str(np.dtype(s.dtype)), s.name) for s in specs]}, path + ".pdiparams")
+
+    # Trainable companion artifact: the same program exported with PARAMS AS
+    # ARGUMENTS and a serialized VJP, so load→append-loss→train works without
+    # the original python model (reference programs are data: append_backward
+    # runs on a loaded ProgramDesc, python/paddle/fluid/backward.py:1413).
+    # Buffers (BN stats, …) stay baked — finetune freezes them, like eval-mode
+    # finetuning on a loaded inference program.
+    if inner_layer is not None and params:
+        named_params = list(inner_layer.named_parameters())
+        p_names = [n for n, _ in named_params]
+        p_list = [p for _, p in named_params]
+
+        def pure_train(param_arrays, *input_arrays):
+            saved = [(t, t._data) for t in p_list + buffers]
+            try:
+                for t, a in zip(p_list, param_arrays):
+                    t._data = a
+                inputs = [Tensor(a, stop_gradient=True) for a in input_arrays]
+                with random_state.traced_keys(jax.random.PRNGKey(0)):
+                    with no_grad():
+                        out = raw_fn(*inputs)
+                return _tree_to_arrays(out)
+            finally:
+                for t, a in saved:
+                    t._data = a
+
+        static_args = [
+            jax.ShapeDtypeStruct(
+                tuple(abs(d) if d is not None and d != -1 else 1 for d in s.shape),
+                s.dtype,
+            )
+            for s in specs
+        ]
+        p_args = [jax.ShapeDtypeStruct(tuple(p.shape), p.dtype) for p in p_list]
+        try:
+            try:
+                # same (possibly symbolic) feed shapes as the primal export,
+                # so load→append_backward→train works at any batch size
+                exp_train = jax.export.export(jax.jit(pure_train))(p_args, *args)
+            except Exception:
+                # vjp not shape-polymorphic for some op: static fallback
+                exp_train = jax.export.export(jax.jit(pure_train))(p_args, *static_args)
+            with open(path + ".pdtrain", "wb") as f:
+                f.write(exp_train.serialize(vjp_order=1))
+            with open(path + ".pdtrain.json", "w") as f:
+                json.dump({"param_names": p_names}, f)
+        except Exception:
+            # not exportable with vjp (e.g. non-differentiable custom calls):
+            # the inference artifact above is still complete
+            for suffix in (".pdtrain", ".pdtrain.json"):
+                if os.path.exists(path + suffix):
+                    os.remove(path + suffix)
 
 
 class TranslatedLayer:

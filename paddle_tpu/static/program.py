@@ -73,10 +73,20 @@ class Block:
 
 
 class Program:
-    """Reference Program over a traced computation."""
+    """Reference Program over a traced computation.
 
-    def __init__(self, closed_jaxpr):
+    Beyond the read-only jaxpr view, a Program produced by
+    ``from_callable`` keeps its CAPTURE (the pure function + input shapes +
+    parameter values), so it supports the reference's program-as-data
+    transforms (``python/paddle/fluid/framework.py`` Program.clone/prune,
+    ``backward.py:1413`` append_backward, ``:2010`` gradients) by re-tracing
+    the capture — the TPU-native equivalent of editing a ProgramDesc.
+    """
+
+    def __init__(self, closed_jaxpr, capture=None):
         self._jaxpr = closed_jaxpr
+        # capture = (pure, feed_shapes, param_arrays); pure(*feeds, *params)
+        self._capture = capture
         main = closed_jaxpr.jaxpr
         self.blocks = [Block(main, 0)]
         # sub-blocks: control-flow bodies (cond branches, scan/while bodies)
@@ -107,7 +117,150 @@ class Program:
             + ("…" if len(self.global_block().ops) > 12 else "") + "}"
         )
 
+
+    # -- transforms (capture-level re-traces) ------------------------------
+    def _require_capture(self):
+        if self._capture is None:
+            raise ValueError(
+                "this Program is a bare jaxpr view; transforms need a "
+                "capture-level Program (build it with Program.from_callable)"
+            )
+        return self._capture
+
+    @property
+    def num_outputs(self):
+        return len(self._jaxpr.jaxpr.outvars)
+
+    def clone(self, for_test: bool = True) -> "Program":
+        """Re-trace the capture into an independent Program (reference
+        Program.clone; for_test has no effect — the capture was traced in
+        eval/no-grad mode already)."""
+        pure, shapes, param_arrays = self._require_capture()
+        return Program(
+            jax.make_jaxpr(pure)(*shapes, *param_arrays),
+            capture=(pure, shapes, list(param_arrays)),
+        )
+
+    def prune(self, targets) -> "Program":
+        """Keep only the outputs in ``targets`` (indices); dead ops are
+        eliminated (reference Program._prune). The re-trace is followed by an
+        explicit DCE pass — tracing alone records every executed op."""
+        from jax.interpreters.partial_eval import dce_jaxpr
+
+        pure, shapes, param_arrays = self._require_capture()
+        idx = [targets] if isinstance(targets, int) else list(targets)
+
+        def pruned(*arrays):
+            outs = pure(*arrays)
+            outs = outs if isinstance(outs, (tuple, list)) else [outs]
+            return tuple(outs[i] for i in idx)
+
+        closed = jax.make_jaxpr(pruned)(*shapes, *param_arrays)
+        try:
+            # instantiate=True keeps all invars so the closed-jaxpr binding
+            # (consts ↔ constvars, args ↔ invars) stays aligned
+            dced, _ = dce_jaxpr(
+                closed.jaxpr, [True] * len(closed.jaxpr.outvars), instantiate=True
+            )
+            closed = closed.replace(jaxpr=dced)
+        except Exception:
+            pass  # DCE is an optimization of the view; the capture is correct
+        return Program(closed, capture=(pruned, shapes, list(param_arrays)))
+
+    def rebind_feeds(self, input_specs) -> "Program":
+        """Re-trace at new feed shapes/dtypes (reference feed-var rebinding:
+        same ops, new feed/fetch binding)."""
+        from .input import InputSpec
+
+        pure, _, param_arrays = self._require_capture()
+        shapes = []
+        for s in input_specs:
+            if isinstance(s, InputSpec):
+                shape = tuple(1 if (d is None or d == -1) else int(d) for d in s.shape)
+                shapes.append(jax.ShapeDtypeStruct(shape, np.dtype(s.dtype)))
+            elif isinstance(s, Tensor):
+                shapes.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
+            else:
+                a = np.asarray(s)
+                shapes.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+        return Program(
+            jax.make_jaxpr(pure)(*shapes, *param_arrays),
+            capture=(pure, shapes, list(param_arrays)),
+        )
+
+    def append_backward(self, loss_index: int = 0) -> "Program":
+        """New Program computing (loss, *param_grads) — the reference's
+        ``append_backward(loss)`` (backward.py:1413) as a grad re-trace."""
+        pure, shapes, param_arrays = self._require_capture()
+        n_feed = len(shapes)
+
+        def with_grads(*arrays):
+            feeds, ps = arrays[:n_feed], list(arrays[n_feed:])
+
+            def loss_of(ps_):
+                outs = pure(*feeds, *ps_)
+                loss = outs[loss_index] if isinstance(outs, (tuple, list)) else outs
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_of)(ps)
+            return (loss, *grads)
+
+        return Program(
+            jax.make_jaxpr(with_grads)(*shapes, *param_arrays),
+            capture=(with_grads, shapes, list(param_arrays)),
+        )
+
+    def gradients(self, target_index: int = 0, input_indices=None) -> "Program":
+        """Grads of output[target_index] wrt the given FEED indices (all feeds
+        by default) — reference ``gradients(targets, inputs)``
+        (backward.py:2010)."""
+        pure, shapes, param_arrays = self._require_capture()
+        n_feed = len(shapes)
+        wrt = list(range(n_feed)) if input_indices is None else (
+            [input_indices] if isinstance(input_indices, int) else list(input_indices)
+        )
+
+        def grad_fn(*arrays):
+            feeds, ps = list(arrays[:n_feed]), list(arrays[n_feed:])
+
+            def target_of(wrt_feeds):
+                f2 = list(feeds)
+                for j, i in enumerate(wrt):
+                    f2[i] = wrt_feeds[j]
+                outs = pure(*f2, *ps)
+                out = outs[target_index] if isinstance(outs, (tuple, list)) else outs
+                return out
+
+            return tuple(jax.grad(target_of)([feeds[i] for i in wrt]))
+
+        return Program(
+            jax.make_jaxpr(grad_fn)(*shapes, *param_arrays),
+            capture=(grad_fn, shapes, list(param_arrays)),
+        )
+
+    def run(self, *feeds):
+        """Execute the captured program (params closed in) on feed arrays.
+        The jitted callable is cached on the Program — repeat runs dispatch,
+        they don't retrace."""
+        pure, shapes, param_arrays = self._require_capture()
+        jitted = getattr(self, "_jitted", None)
+        if jitted is None:
+            jitted = self._jitted = jax.jit(pure)
+        arrays = [
+            f._data if isinstance(f, Tensor) else jax.numpy.asarray(f) for f in feeds
+        ]
+        outs = jitted(*arrays, *param_arrays)
+        return [Tensor(o, stop_gradient=True) for o in (
+            outs if isinstance(outs, (tuple, list)) else [outs]
+        )]
+
     # -- construction ------------------------------------------------------
+    @staticmethod
+    def load(path_prefix: str) -> "TrainableProgram":
+        """Load a saved inference artifact as a trainable program (the
+        reference load→append_backward→train workflow on a ProgramDesc)."""
+        return TrainableProgram.load(path_prefix)
+
     @staticmethod
     def from_callable(fn, input_specs: Sequence[Any], layer=None) -> "Program":
         """Trace ``fn(*tensors)`` (a Layer or python fn over Tensors) at the
@@ -144,5 +297,111 @@ class Program:
                 for t, a in saved:
                     t._data = a
 
-        closed = jax.make_jaxpr(pure)(*shapes, *[p._data for p in params])
-        return Program(closed)
+        param_arrays = [p._data for p in params]
+        closed = jax.make_jaxpr(pure)(*shapes, *param_arrays)
+        return Program(closed, capture=(pure, shapes, param_arrays))
+
+
+class TrainableProgram:
+    """A ``jit.save``d artifact reloaded WITH parameters as program inputs
+    and a serialized VJP (the ``.pdtrain`` companion written by jit.save), so
+    the reference's load → append loss+grads → train workflow
+    (``backward.py:1413`` on a loaded ProgramDesc) works without the original
+    python model. Gradients flow through the deserialized StableHLO via
+    ``jax.export`` vjp; buffers (BN stats) are baked eval-mode constants."""
+
+    def __init__(self, exported, param_names, params, state):
+        self._exported = exported
+        self.param_names = param_names
+        self._params = params  # list of jnp arrays, aligned with param_names
+        self._state = state  # full named state dict (numpy), incl. buffers
+        self._step = None
+        self._loss_fn = None
+
+    @staticmethod
+    def load(path_prefix: str) -> "TrainableProgram":
+        import json as _json
+
+        with open(path_prefix + ".pdtrain", "rb") as f:
+            exported = jax.export.deserialize(f.read())
+        with open(path_prefix + ".pdtrain.json") as f:
+            param_names = _json.load(f)["param_names"]
+        from ..framework.io import load as fload
+
+        meta = fload(path_prefix + ".pdiparams")
+        state = {k: np.asarray(v._data) for k, v in meta["state"].items()}
+        params = [jax.numpy.asarray(state[n]) for n in param_names]
+        return TrainableProgram(exported, param_names, params, state)
+
+    def __call__(self, *feeds):
+        arrays = [
+            f._data if isinstance(f, Tensor) else jax.numpy.asarray(f) for f in feeds
+        ]
+        outs = self._exported.call(self._params, *arrays)
+        outs = outs if isinstance(outs, (tuple, list)) else [outs]
+        return [Tensor(o, stop_gradient=True) for o in outs]
+
+    def append_backward(self, loss_fn):
+        """Attach ``loss_fn(outputs, *labels) -> scalar`` and build the fused
+        train step (fwd through the loaded program + vjp + SGD update)."""
+        self._loss_fn = loss_fn
+        call = self._exported.call
+
+        @jax.jit
+        def step(params, lr, feeds, labels):
+            def loss_of(ps):
+                outs = call(ps, *feeds)
+                outs = outs if isinstance(outs, (tuple, list)) else [outs]
+                # loss_fn sees Tensors (paddle losses); grads flow at the
+                # array level through jax.value_and_grad, not the eager tape
+                outs_t = [Tensor(o, stop_gradient=True) for o in outs]
+                labels_t = [Tensor(l, stop_gradient=True) for l in labels]
+                loss = loss_fn(outs_t, *labels_t)
+                return loss._data if isinstance(loss, Tensor) else loss
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            new_params = [p - lr * g for p, g in zip(params, grads)]
+            return loss, new_params
+
+        self._step = step
+        return self
+
+    def gradients(self, feeds, labels):
+        """(loss, {param_name: grad}) at the current parameters."""
+        if self._loss_fn is None:
+            raise ValueError("call append_backward(loss_fn) first")
+        call, loss_fn = self._exported.call, self._loss_fn
+
+        def loss_of(ps):
+            outs = call(ps, *[_as_array(f) for f in feeds])
+            outs = outs if isinstance(outs, (tuple, list)) else [outs]
+            outs_t = [Tensor(o, stop_gradient=True) for o in outs]
+            labels_t = [Tensor(_as_array(l), stop_gradient=True) for l in labels]
+            loss = loss_fn(outs_t, *labels_t)
+            return loss._data if isinstance(loss, Tensor) else loss
+
+        loss, grads = jax.value_and_grad(loss_of)(self._params)
+        return Tensor(loss), dict(zip(self.param_names, (Tensor(g) for g in grads)))
+
+    def train_step(self, feeds, labels, lr=0.01):
+        """One SGD step on the loaded program; updates held params in place."""
+        if self._step is None:
+            raise ValueError("call append_backward(loss_fn) first")
+        feeds_a = tuple(_as_array(f) for f in feeds)
+        labels_a = tuple(_as_array(l) for l in labels)
+        loss, new_params = self._step(
+            self._params, jax.numpy.float32(lr), feeds_a, labels_a
+        )
+        self._params = list(new_params)
+        return Tensor(loss)
+
+    def state_dict(self):
+        """Full state with the trained parameter values folded back in."""
+        out = {k: Tensor(v) for k, v in self._state.items()}
+        for n, p in zip(self.param_names, self._params):
+            out[n] = Tensor(p)
+        return out
+
+
+def _as_array(x):
+    return x._data if isinstance(x, Tensor) else jax.numpy.asarray(x)

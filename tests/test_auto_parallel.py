@@ -1,0 +1,238 @@
+"""Auto-parallel: candidate-plan derivation, cost-model selection, reshard,
+and parity of the AUTO placement with the hand-written Megatron placement
+(reference auto_parallel completion.py:111 + partitioner.py + reshard.py +
+cost_model, collapsed GSPMD-first: plans pin parameters, XLA partitions ops
+and inserts collectives; selection scores the real compiled step)."""
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.auto_parallel import (
+    Engine,
+    ShardingPlan,
+    analyze_collectives,
+    complete_annotations,
+    derive_candidate_plans,
+    plan_cost,
+    reshard,
+    select_plan,
+)
+from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+
+def _mesh(axes, shape):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, axes)
+
+
+def _tiny_cfg(use_mp):
+    return GPTConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        max_position_embeddings=32, hidden_dropout=0.0, attention_dropout=0.0,
+        use_mp_layers=use_mp,
+    )
+
+
+def _collective_counts(hlo_text):
+    return analyze_collectives(hlo_text)["counts"]
+
+
+def _strip_pspecs(model):
+    """The GPT model builds mp_layers with intrinsic pspecs; clearing them
+    yields the unannotated model auto-parallel must handle."""
+    for _, p in model.named_parameters():
+        p.pspec = None
+    return model
+
+
+class TestCompletion:
+    def test_megatron_plan_pairs_col_row_per_parent(self):
+        mesh = _mesh(("mp",), (8,))
+        model = GPTForPretraining(_tiny_cfg(use_mp=False))
+        _strip_pspecs(model)  # model must be genuinely unannotated
+        complete_annotations(model, mesh)
+        specs = {n: getattr(p, "pspec", None) for n, p in model.named_parameters()}
+        # qkv/up are column (out-dim over mp, bias sharded); proj/down are row
+        for n, s in specs.items():
+            if ".qkv.weight" in n or ".up.weight" in n:
+                assert s == P(None, "mp"), (n, s)
+            if ".qkv.bias" in n or ".up.bias" in n:
+                assert s == P("mp"), (n, s)
+            if ".proj.weight" in n or ".down.weight" in n:
+                assert s == P("mp", None), (n, s)
+            if "word_embeddings" in n and n.endswith("weight"):
+                assert s == P("mp", None), (n, s)
+            if ".proj.bias" in n or ".down.bias" in n or "ln" in n:
+                assert s is None, (n, s)
+
+    def test_interleaved_params_cannot_desync_pairing(self):
+        # the round-3 heuristic alternated a GLOBAL flip counter; a sibling
+        # module with an odd number of 2-D weights desynchronized everything
+        # after it. The structure-aware pass pairs per parent.
+        class Odd(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.solo = nn.Linear(32, 32)  # odd single weight
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(32, 128)
+                self.fc2 = nn.Linear(128, 32)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.odd = Odd()
+                self.block = Block()
+
+        mesh = _mesh(("mp",), (8,))
+        model = Net()
+        complete_annotations(model, mesh)
+        named = dict(model.named_parameters())
+        assert named["block.fc1.weight"].pspec == P(None, "mp")
+        assert named["block.fc2.weight"].pspec == P("mp", None)
+
+    def test_user_annotation_wins(self):
+        mesh = _mesh(("mp",), (8,))
+        model = GPTForPretraining(_tiny_cfg(use_mp=False))
+        named = dict(model.named_parameters())
+        some = next(n for n in named if n.endswith("qkv.weight"))
+        named[some].pspec = P()  # user says: replicate this one
+        complete_annotations(model, mesh)
+        assert named[some].pspec == P()
+
+
+class TestAutoVsHandMegatron:
+    def _loss(self, model, ids, labels):
+        return model.loss(ids, labels)
+
+    def _lower(self, model, mesh):
+        from paddle_tpu.distributed.engine import HybridParallelEngine
+
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        eng = HybridParallelEngine(model, opt, self._loss, mesh=mesh, dp_axes=())
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 512, (2, 32)))
+        labels = paddle.to_tensor(rng.randint(0, 512, (2, 32)))
+        args = eng._prepare(ids, labels)
+        return eng._jit.lower(*args).compile().as_text()
+
+    def test_auto_placement_matches_hand_megatron_collectives(self):
+        mesh = _mesh(("mp",), (8,))
+
+        # hand: the intrinsic mp_layers pspecs (Megatron placement); auto:
+        # identical python model with ALL pspecs stripped, re-derived by
+        # completion. Same forward path → the comparison isolates placement.
+        paddle.seed(0)
+        hand = GPTForPretraining(_tiny_cfg(use_mp=True))
+        hand_specs = {
+            n: getattr(p, "pspec", None) for n, p in hand.named_parameters()
+        }
+        hand_counts = _collective_counts(self._lower(hand, mesh))
+
+        paddle.seed(0)
+        auto = GPTForPretraining(_tiny_cfg(use_mp=True))
+        _strip_pspecs(auto)
+        complete_annotations(auto, mesh)
+        auto_specs = {
+            n: getattr(p, "pspec", None) for n, p in auto.named_parameters()
+        }
+        auto_counts = _collective_counts(self._lower(auto, mesh))
+
+        # completion re-derives the hand placement param-for-param (P() and
+        # None both mean replicated)
+        def norm(s):
+            return None if s is None or s == P() else s
+
+        for n in hand_specs:
+            assert norm(auto_specs[n]) == norm(hand_specs[n]), (
+                n, auto_specs[n], hand_specs[n],
+            )
+        # … and therefore GSPMD emits the same collectives
+        assert auto_counts == hand_counts, (auto_counts, hand_counts)
+
+
+class TestPlanSelection:
+    def test_select_plan_prefers_sharded_compute(self):
+        mesh = _mesh(("mp",), (8,))
+        paddle.seed(1)
+        model = GPTForPretraining(_tiny_cfg(use_mp=False))
+        _strip_pspecs(model)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        eng = Engine(model, loss=None, optimizer=opt, mesh=mesh)
+        eng.loss = None
+
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 512, (2, 32)))
+        labels = paddle.to_tensor(rng.randint(0, 512, (2, 32)))
+
+        def loss(model, ids, labels):
+            return model.loss(ids, labels)
+
+        # drive selection through the public engine path
+        eng.loss = None
+        eng_loss = loss
+        from paddle_tpu.distributed.engine import HybridParallelEngine
+        from paddle_tpu.distributed.auto_parallel import derive_candidate_plans
+
+        plans = derive_candidate_plans(model, mesh)
+        assert [p.name for p in plans][:2] == ["megatron", "replicated"]
+
+        def build_compiled():
+            e = HybridParallelEngine(model, opt, eng_loss, mesh=mesh, dp_axes=(), donate=False)
+            args = e._prepare(ids, labels)
+            return e._jit.lower(*args).compile()
+
+        best = select_plan(model, plans, build_compiled)
+        assert best.report["comm_counts"], "winning plan should communicate"
+        # the sharded plan must beat full replication on the roofline score
+        rep = next(p for p in plans if p.name == "replicated")
+        if rep.score is not None:
+            assert best.score <= rep.score
+        # per-device flops of the winner ≲ replicated's (compute partitioned)
+        if rep.report:
+            assert best.report["flops"] < rep.report["flops"]
+
+    def test_plan_cost_reports_comm_and_memory(self):
+        mesh = _mesh(("mp",), (8,))
+        w = np.ones((64, 64), np.float32)
+
+        def f(x, w):
+            y = x @ w
+            return jax.lax.with_sharding_constraint(
+                y, jax.sharding.NamedSharding(mesh, P())
+            ).sum()
+
+        xs = jax.ShapeDtypeStruct((8, 64), np.float32)
+        ws = jax.ShapeDtypeStruct((64, 64), np.float32)
+        with mesh:
+            compiled = (
+                jax.jit(f, in_shardings=(jax.sharding.NamedSharding(mesh, P("mp", None)),
+                                         jax.sharding.NamedSharding(mesh, P(None, "mp"))))
+                .lower(xs, ws).compile()
+            )
+        rep = plan_cost(compiled)
+        assert rep["peak_memory_bytes"] > 0
+        assert rep["time_proxy"] > 0
+
+
+class TestReshard:
+    def test_reshard_eager_changes_placement(self):
+        mesh = _mesh(("x",), (8,))
+        t = paddle.to_tensor(np.arange(64, dtype=np.float32).reshape(8, 8))
+        t2 = reshard(t, P("x", None), mesh=mesh)
+        shard_shapes = {s.data.shape for s in t2._data.addressable_shards}
+        assert shard_shapes == {(1, 8)}
+        np.testing.assert_array_equal(t2.numpy(), t.numpy())
+
+    def test_reshard_traced_inserts_constraint(self):
+        mesh = _mesh(("x",), (8,))
+
+        def f(a):
+            return reshard(a, P("x", None), mesh=mesh) * 2
+
+        text = jax.jit(f).lower(jax.ShapeDtypeStruct((8, 8), np.float32)).as_text()
+        assert "@Sharding" in text or "sdy.sharding_constraint" in text

@@ -74,6 +74,10 @@ class TestMultiprocessWorkers:
                 return 16
 
             def __getitem__(self, i):
+                # slow items: under CI load one fast worker could otherwise
+                # drain the whole index queue before the others even start,
+                # collapsing pids to a single value and flaking the test
+                time.sleep(0.05)
                 return np.asarray([os.getpid()], dtype=np.int64)
 
         parent = os.getpid()

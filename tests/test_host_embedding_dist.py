@@ -1,0 +1,160 @@
+"""Distributed (sharded) host embedding: 2 real processes, table sharded by
+id over the native TCPStore, pull/push parity with a single-process table
+(reference PS methodology: test_dist_base.py loss-parity between 1-proc and
+N-proc runs; capability of memory_sparse_table.cc + the_one_ps.py:606)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+WORKER = textwrap.dedent(
+    """
+    import os, json
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.native import TCPStore, lib
+    from paddle_tpu.incubate.host_embedding import (
+        HostEmbedding, ShardedHostEmbeddingTable, sharded_host_embedding,
+    )
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    port = int(os.environ["PADDLE_EMB_STORE_PORT"])
+
+    emb = sharded_host_embedding(64, 8, seed=3)
+    assert isinstance(emb.table, ShardedHostEmbeddingTable), type(emb.table)
+
+    # both ranks run the SAME global batches (dp would split them; identical
+    # batches make the single-process comparison exact)
+    steps = []
+    for step in range(3):
+        rng = np.random.RandomState(100 + step)
+        ids = rng.randint(0, 64, (4, 5))
+        out = emb(paddle.to_tensor(ids))
+        loss = paddle.sum(out * out)
+        loss.backward()
+        emb.apply_gradients(lr=0.1)
+        steps.append(float(loss.numpy()))
+    print(json.dumps({"rank": rank, "losses": steps}), flush=True)
+    """
+)
+
+
+class TestShardedHostEmbedding:
+    def test_two_process_parity_with_single_table(self):
+        from paddle_tpu.core.native import lib
+
+        if lib() is None:
+            pytest.skip("native runtime not built")
+        port = _free_port()
+        procs = []
+        for rank in range(2):
+            env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS",)}
+            env.update(
+                {
+                    "PYTHONPATH": REPO,
+                    "JAX_PLATFORMS": "cpu",
+                    "PADDLE_TRAINER_ID": str(rank),
+                    "PADDLE_TRAINERS_NUM": "2",
+                    "PADDLE_EMB_STORE_PORT": str(port),
+                }
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", WORKER],
+                    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                )
+            )
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, err.decode()[-2000:]
+            outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+
+        # both ranks observe identical losses (same global batch, sync PS)
+        assert outs[0]["losses"] == outs[1]["losses"], outs
+
+        # single-process reference: same seeds, same batches, plain table
+        from paddle_tpu.incubate.host_embedding import HostEmbedding
+        import paddle_tpu as paddle
+
+        emb = HostEmbedding(64, 8, seed=3)
+        ref = []
+        for step in range(3):
+            rng = np.random.RandomState(100 + step)
+            ids = rng.randint(0, 64, (4, 5))
+            out = emb(paddle.to_tensor(ids))
+            loss = paddle.sum(out * out)
+            loss.backward()
+            # two ranks each pushed the same grads → the sharded run applied
+            # a 2x summed update; mirror that for exact parity
+            for uniq, rows in emb._pending:
+                if rows.grad is not None:
+                    rows.grad._set_data(rows.grad._data * 2.0)
+            emb.apply_gradients(lr=0.1)
+            ref.append(float(loss.numpy()))
+        np.testing.assert_allclose(outs[0]["losses"], ref, rtol=1e-5)
+
+
+class TestCoalescedPush:
+    def test_duplicate_ids_across_microbatches_merge(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.host_embedding import HostEmbedding
+
+        emb = HostEmbedding(32, 4, seed=1)
+        calls = []
+        orig = emb.table.apply_update
+
+        def counting(ids, grad, lr):
+            calls.append(np.asarray(ids))
+            return orig(ids, grad, lr)
+
+        emb.table.apply_update = counting
+        for _ in range(3):  # 3 microbatches touching overlapping ids
+            out = emb(paddle.to_tensor(np.array([[1, 2], [2, 3]])))
+            paddle.sum(out * out).backward()
+        emb.apply_gradients(lr=0.05)
+        assert len(calls) == 1, "pushes not coalesced"
+        np.testing.assert_array_equal(calls[0], [1, 2, 3])
+
+    def test_vectorized_init_deterministic_per_row(self):
+        from paddle_tpu.incubate.host_embedding import HostEmbeddingTable
+
+        a = HostEmbeddingTable(100, 16, seed=9)
+        b = HostEmbeddingTable(100, 16, seed=9)
+        r1 = a.gather(np.array([5, 50, 99]))
+        r2 = b.gather(np.array([99, 5, 7, 50]))  # different touch order/set
+        np.testing.assert_allclose(r1[0], r2[1])
+        np.testing.assert_allclose(r1[1], r2[3])
+        np.testing.assert_allclose(r1[2], r2[0])
+        # distribution sanity: ~N(0, 0.01)
+        big = a.gather(np.arange(100))
+        assert abs(float(big.std()) - 0.01) < 0.003
+
+    def test_prefetch_overlaps_and_matches(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.host_embedding import HostEmbedding
+
+        emb = HostEmbedding(64, 8, seed=2)
+        ids = np.array([[3, 4, 5]])
+        ref = emb(paddle.to_tensor(ids)).numpy()
+        emb2 = HostEmbedding(64, 8, seed=2)
+        emb2.prefetch(np.asarray(ids))
+        got = emb2(paddle.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(got, ref)

@@ -196,3 +196,26 @@ class TestLazyInterop:
         np.testing.assert_allclose(
             m2.weight.numpy(), m.weight.numpy(), rtol=1e-6
         )
+
+
+class TestLazyDunders:
+    """Raw operator use on a LazyArray must RECORD, not flush (round-3
+    verdict: a stray `lazy + 1` inside a library split the fused iteration)."""
+
+    def test_arithmetic_stays_lazy(self):
+        t = paddle.to_tensor(np.arange(8, dtype=np.float32))
+        a = (t * 2.0)._data  # lazy product
+        assert lazy.is_lazy(a)
+        for expr in (a + 1.0, 1.0 + a, a - 1.0, a * 3.0, -a, a / 2.0, a ** 2):
+            assert lazy.is_lazy(expr), expr
+        assert lazy.is_lazy(a[2])  # static getitem records too
+        np.testing.assert_allclose(np.asarray(a + 1.0), np.arange(8) * 2.0 + 1.0)
+
+    def test_values_correct_through_lazy_ops(self):
+        t = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        a = (t + 0.0)._data
+        out = ((2.0 * a - 1.0) / 2.0) ** 2
+        np.testing.assert_allclose(
+            np.asarray(out), ((2 * np.array([1.0, 2, 3]) - 1) / 2) ** 2
+        )
+        np.testing.assert_allclose(float(np.asarray(a[1])), 2.0)

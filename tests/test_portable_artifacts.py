@@ -1,0 +1,116 @@
+"""Portable-artifact story: (1) paddle.onnx.export writes the portable
+StableHLO interchange artifact and a CPU-ONLY subprocess (no TPU visible)
+loads and runs it — the deployment property the reference gets from
+paddle2onnx; (2) a standalone C++ binary (runtime_cpp/capi_demo.cc, the
+goapi-role second-language consumer) drives the C ABI end to end."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model():
+    paddle.seed(11)
+    return nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 3))
+
+
+class TestPortableExport:
+    def test_onnx_export_writes_portable_artifact(self, tmp_path):
+        m = _model()
+        m.eval()
+        prefix = paddle.onnx.export(
+            m, str(tmp_path / "net.onnx"), input_spec=[InputSpec([2, 6], "float32")]
+        )
+        assert os.path.exists(prefix + ".pdmodel")
+        assert os.path.exists(prefix + ".pdiparams")
+
+    def test_onnx_format_raises_with_guidance(self, tmp_path):
+        with pytest.raises(NotImplementedError, match="paddle2onnx|StableHLO"):
+            paddle.onnx.export(
+                _model(), str(tmp_path / "x"),
+                input_spec=[InputSpec([2, 6], "float32")], format="onnx",
+            )
+
+    def test_cpu_only_subprocess_loads_and_matches(self, tmp_path):
+        m = _model()
+        m.eval()
+        x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+        want = m(paddle.to_tensor(x)).numpy()
+        prefix = paddle.onnx.export(
+            m, str(tmp_path / "net"), input_spec=[InputSpec([2, 6], "float32")]
+        )
+        np.save(tmp_path / "x.npy", x)
+        np.save(tmp_path / "want.npy", want)
+
+        script = textwrap.dedent(
+            f"""
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"  # no TPU in this process
+            import numpy as np
+            import paddle_tpu as paddle
+            layer = paddle.jit.load({prefix!r})
+            x = np.load({str(tmp_path / 'x.npy')!r})
+            out = layer(paddle.to_tensor(x))
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            np.testing.assert_allclose(
+                out.numpy(), np.load({str(tmp_path / 'want.npy')!r}),
+                rtol=1e-4, atol=1e-5,
+            )
+            print("PORTABLE_OK")
+            """
+        )
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env.update({"PYTHONPATH": ROOT, "JAX_PLATFORMS": "cpu"})
+        r = subprocess.run(
+            [sys.executable, "-c", script], env=env,
+            capture_output=True, text=True, timeout=240,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "PORTABLE_OK" in r.stdout
+
+
+class TestCppConsumer:
+    def test_capi_demo_binary_runs_artifact(self, tmp_path):
+        demo = os.path.join(ROOT, "runtime_cpp", "capi_demo")
+        if not os.path.exists(demo):
+            r = subprocess.run(
+                ["make", "-C", os.path.join(ROOT, "runtime_cpp"), "capi_demo"],
+                capture_output=True,
+            )
+            if r.returncode != 0:
+                pytest.skip(f"capi_demo build unavailable: {r.stderr[-300:]}")
+
+        m = _model()
+        m.eval()
+        prefix = str(tmp_path / "net")
+        paddle.static.save_inference_model(
+            prefix, [InputSpec([2, 6], "float32")], m
+        )
+        # same deterministic ramp the C++ host feeds
+        n = 12
+        x = (np.arange(n) % 17).astype(np.float32) * 0.25 - 2.0
+        want = m(paddle.to_tensor(x.reshape(2, 6))).numpy()
+
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+        r = subprocess.run(
+            [demo, prefix, ROOT, "2", "6"], env=env,
+            capture_output=True, text=True, timeout=240,
+        )
+        assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+        got = json.loads(r.stdout.strip().splitlines()[-1])
+        assert got["numel"] == want.size
+        np.testing.assert_allclose(got["sum"], float(want.sum()), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            got["head"], want.ravel()[:4], rtol=1e-4, atol=1e-5
+        )

@@ -1,0 +1,160 @@
+"""Program-as-data transforms (reference framework.py Program API +
+backward.py:1413 append_backward / :2010 gradients): capture-level clone /
+prune / feed rebinding / grad programs, and the save → load →
+append-loss-and-grads → train-a-step workflow on a .pdtrain artifact."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import (
+    InputSpec,
+    Program,
+    append_backward,
+    gradients,
+    load_program,
+    save_inference_model,
+)
+
+
+def _mlp():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(6, 16), nn.Tanh(), nn.Linear(16, 3))
+
+
+class TestProgramTransforms:
+    def test_clone_is_independent_and_equal(self):
+        m = _mlp()
+        p = Program.from_callable(m, [InputSpec([2, 6], "float32")])
+        p2 = p.clone()
+        assert p2 is not p
+        assert p2.global_block().all_op_types() == p.global_block().all_op_types()
+        x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            p.run(x)[0].numpy(), p2.run(x)[0].numpy(), rtol=1e-6
+        )
+
+    def test_prune_drops_dead_ops(self):
+        m = _mlp()
+
+        def two_headed(x):
+            h = m(x)
+            return h, paddle.exp(paddle.sum(h * h))  # second head: extra ops
+
+        p = Program.from_callable(two_headed, [InputSpec([2, 6], "float32")], layer=m)
+        pruned = p.prune(0)  # keep only the first output
+        assert pruned.num_outputs == 1
+        assert pruned.num_ops() < p.num_ops()  # exp/sum head vanished
+        x = np.random.RandomState(1).randn(2, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            pruned.run(x)[0].numpy(), p.run(x)[0].numpy(), rtol=1e-6
+        )
+
+    def test_rebind_feeds_new_batch(self):
+        m = _mlp()
+        p = Program.from_callable(m, [InputSpec([2, 6], "float32")])
+        p8 = p.rebind_feeds([InputSpec([8, 6], "float32")])
+        x = np.random.RandomState(2).randn(8, 6).astype(np.float32)
+        out = p8.run(x)[0].numpy()
+        assert out.shape == (8, 3)
+        np.testing.assert_allclose(
+            out[:2], p.run(x[:2])[0].numpy(), rtol=1e-5, atol=1e-6
+        )
+
+    def test_append_backward_matches_autograd(self):
+        m = _mlp()
+
+        def loss_prog(x):
+            return paddle.mean(m(x) ** 2)
+
+        # params become program inputs only when the owning layer is named
+        # (reference: append_backward needs params as Variables, not consts)
+        p = Program.from_callable(loss_prog, [InputSpec([4, 6], "float32")], layer=m)
+        bp = append_backward(program=p)
+        x = np.random.RandomState(3).randn(4, 6).astype(np.float32)
+        outs = bp.run(x)
+        loss, grads = outs[0], outs[1:]
+        assert len(grads) == len([p_ for p_ in m.parameters() if not p_.stop_gradient])
+
+        # parity vs the eager tape
+        xt = paddle.to_tensor(x)
+        l2 = paddle.mean(m(xt) ** 2)
+        l2.backward()
+        np.testing.assert_allclose(float(loss.numpy()), float(l2.numpy()), rtol=1e-5)
+        eager_grads = [p_.grad.numpy() for p_ in m.parameters() if p_.grad is not None]
+        for g_prog, g_eager in zip(grads, eager_grads):
+            np.testing.assert_allclose(g_prog.numpy(), g_eager, rtol=1e-4, atol=1e-5)
+
+    def test_gradients_wrt_feed(self):
+        m = _mlp()
+
+        def loss_prog(x):
+            return paddle.sum(m(x))
+
+        p = Program.from_callable(loss_prog, [InputSpec([2, 6], "float32")])
+        gp = gradients(program=p, inputs=0)
+        x = np.random.RandomState(4).randn(2, 6).astype(np.float32)
+        gx = gp.run(x)[0].numpy()
+        assert gx.shape == (2, 6)
+        # finite-difference spot check on one coordinate
+        eps = 1e-3
+        xp = x.copy(); xp[0, 0] += eps
+        xm = x.copy(); xm[0, 0] -= eps
+        fd = (float(p.run(xp)[0].numpy()) - float(p.run(xm)[0].numpy())) / (2 * eps)
+        np.testing.assert_allclose(gx[0, 0], fd, rtol=1e-2, atol=1e-3)
+
+
+class TestLoadFinetune:
+    def test_save_load_append_loss_train_step(self, tmp_path):
+        m = _mlp()
+        prefix = str(tmp_path / "prog")
+        save_inference_model(prefix, [InputSpec([4, 6], "float32")], m)
+
+        prog = load_program(prefix)
+        assert prog.param_names  # params are program inputs, not constants
+
+        x = np.random.RandomState(5).randn(4, 6).astype(np.float32)
+        y = np.random.RandomState(6).randn(4, 3).astype(np.float32)
+
+        # loaded forward matches the live model
+        np.testing.assert_allclose(
+            prog(x)[0].numpy(), m(paddle.to_tensor(x)).numpy(), rtol=1e-5, atol=1e-6
+        )
+
+        prog.append_backward(
+            lambda outs, label: paddle.mean((outs[0] - label) ** 2)
+        )
+        loss0, grads = prog.gradients([x], [y])
+        assert set(grads) == set(prog.param_names)
+        assert all(np.isfinite(g.numpy()).all() for g in grads.values())
+
+        losses = [float(prog.train_step([x], [y], lr=0.05).numpy()) for _ in range(5)]
+        assert losses[-1] < losses[0], f"no descent: {losses}"
+
+        # trained params round-trip through state_dict back into a live model
+        m2 = _mlp()
+        m2.set_state_dict(prog.state_dict())
+        out_trained = prog(x)[0].numpy()
+        np.testing.assert_allclose(
+            m2(paddle.to_tensor(x)).numpy(), out_trained, rtol=1e-5, atol=1e-6
+        )
+
+    def test_grad_parity_with_eager(self, tmp_path):
+        m = _mlp()
+        prefix = str(tmp_path / "prog2")
+        save_inference_model(prefix, [InputSpec([4, 6], "float32")], m)
+        prog = load_program(prefix)
+        prog.append_backward(lambda outs, label: paddle.mean((outs[0] - label) ** 2))
+
+        x = np.random.RandomState(8).randn(4, 6).astype(np.float32)
+        y = np.random.RandomState(9).randn(4, 3).astype(np.float32)
+        _, grads = prog.gradients([x], [y])
+
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        loss = paddle.mean((m(xt) - yt) ** 2)
+        loss.backward()
+        named = dict(m.named_parameters())
+        for name, g in grads.items():
+            np.testing.assert_allclose(
+                g.numpy(), named[name].grad.numpy(), rtol=1e-4, atol=1e-5
+            )

@@ -1,0 +1,150 @@
+"""Variable-length batching policy (SURVEY §7 hard part (c)): BucketSampler
++ pad-to-bucket collate bound the number of compiled executables to the
+bucket count, and masked loss over bucketed padding matches dense padding
+(the reference's LoD/sequence_ops capability, shape-quantized for XLA)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import (
+    BucketSampler,
+    DataLoader,
+    Dataset,
+    bucket_boundaries,
+    pad_to_bucket_collate,
+)
+
+
+class RaggedText(Dataset):
+    """Token sequences with lengths 3..41."""
+
+    def __init__(self, n=64, vocab=50, seed=0):
+        rng = np.random.RandomState(seed)
+        self.seqs = [
+            rng.randint(1, vocab, (int(L),)).astype(np.int64)
+            for L in rng.randint(3, 42, n)
+        ]
+
+    def __len__(self):
+        return len(self.seqs)
+
+    def __getitem__(self, i):
+        ids = self.seqs[i]
+        return ids, ids  # next-token style: labels = ids (shifted in model)
+
+
+class TinyLM(nn.Layer):
+    def __init__(self, vocab=50, dim=32):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, dim)
+        self.fc = nn.Linear(dim, vocab)
+
+    def forward(self, ids):
+        return self.fc(self.emb(ids))
+
+
+class TestBucketSampler:
+    def test_boundaries_cover_and_align(self):
+        lengths = np.random.RandomState(0).randint(3, 100, 500)
+        bounds = bucket_boundaries(lengths, num_buckets=6)
+        assert bounds == sorted(bounds)
+        assert all(b % 8 == 0 for b in bounds)
+        assert bounds[-1] >= lengths.max()
+
+    def test_batches_are_single_bucket(self):
+        ds = RaggedText(64)
+        lengths = [len(s) for s in ds.seqs]
+        bs = BucketSampler(lengths, batch_size=4, num_buckets=4)
+        seen = set()
+        count = 0
+        for batch in bs:
+            widths = {
+                next(b for b in bs.boundaries if len(ds.seqs[i]) <= b) for i in batch
+            }
+            assert len(widths) == 1, "mixed buckets in one batch"
+            seen.update(widths)
+            count += len(batch)
+        assert count == len(ds)  # every sample batched exactly once
+        assert len(seen) <= len(bs.boundaries)
+
+    def test_compile_budget_bounded_by_buckets(self):
+        """The ragged loader yields at most len(boundaries) distinct padded
+        shapes → at most that many executables for a shape-keyed jit."""
+        ds = RaggedText(64)
+        lengths = [len(s) for s in ds.seqs]
+        bs = BucketSampler(lengths, batch_size=8, num_buckets=4, drop_last=False)
+        collate = pad_to_bucket_collate(bs.boundaries, returns_label=True)
+        loader = DataLoader(
+            ds, batch_sampler=bs, collate_fn=lambda b: collate(b), num_workers=0,
+            use_shared_memory=False,
+        )
+        shapes = set()
+        for ids, labels, lens in loader:
+            arr = ids.numpy() if hasattr(ids, "numpy") else np.asarray(ids)
+            shapes.add(arr.shape[1])
+        assert len(shapes) <= len(bs.boundaries), (shapes, bs.boundaries)
+        assert shapes <= set(bs.boundaries)
+
+    def test_masked_loss_parity_bucketed_vs_dense_padding(self):
+        """Per-token CE over bucket-padded batches == the same sequences
+        padded to the global max (ignore_index masks pads either way)."""
+        paddle.seed(3)
+        model = TinyLM()
+        lossf = nn.CrossEntropyLoss(ignore_index=-100)
+
+        ds = RaggedText(16, seed=5)
+        seqs = ds.seqs
+        bounds = bucket_boundaries([len(s) for s in seqs], num_buckets=3)
+        collate = pad_to_bucket_collate(bounds, returns_label=True)
+
+        def token_loss(ids_np, lab_np):
+            logits = model(paddle.to_tensor(ids_np))
+            return lossf(
+                paddle.reshape(logits, [-1, 50]),
+                paddle.to_tensor(lab_np.reshape(-1)),
+            )
+
+        # bucketed: batch of 4 short sequences
+        batch = [ (seqs[i], seqs[i]) for i in range(4) ]
+        ids_b, lab_b, _ = collate(batch)
+
+        # dense: same 4 sequences padded to the GLOBAL max width
+        width = max(len(s) for s in seqs)
+        ids_d = np.zeros((4, width), np.int64)
+        lab_d = np.full((4, width), -100, np.int64)
+        for i in range(4):
+            ids_d[i, : len(seqs[i])] = seqs[i]
+            lab_d[i, : len(seqs[i])] = seqs[i]
+
+        lb = float(token_loss(ids_b, lab_b).numpy())
+        ld = float(token_loss(ids_d, lab_d).numpy())
+        np.testing.assert_allclose(lb, ld, rtol=1e-5)
+
+    def test_ragged_training_descends(self):
+        paddle.seed(1)
+        model = TinyLM()
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=model.parameters())
+        lossf = nn.CrossEntropyLoss(ignore_index=-100)
+
+        ds = RaggedText(32, seed=2)
+        lengths = [len(s) for s in ds.seqs]
+        bs = BucketSampler(lengths, batch_size=8, num_buckets=3, shuffle=True)
+        collate = pad_to_bucket_collate(bs.boundaries, returns_label=True)
+        loader = DataLoader(
+            ds, batch_sampler=bs, collate_fn=lambda b: collate(b), num_workers=0,
+            use_shared_memory=False,
+        )
+
+        losses = []
+        for _ in range(4):
+            for ids, labels, lens in loader:
+                logits = model(paddle.to_tensor(np.asarray(ids._data if hasattr(ids, '_data') else ids)))
+                loss = lossf(
+                    paddle.reshape(logits, [-1, 50]),
+                    paddle.reshape(paddle.to_tensor(np.asarray(labels._data if hasattr(labels, '_data') else labels)), [-1]),
+                )
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses[:3] + losses[-3:]
