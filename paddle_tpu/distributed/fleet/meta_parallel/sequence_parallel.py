@@ -23,16 +23,58 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _block_attn(q, k, v, causal_mask=None, scale=None):
-    """One Q-block × K/V-block partial attention: returns (out_unnorm, m, l)."""
+_Q_CHUNK = 512  # per-chunk score block is (C, T_local): memory ∝ C·T, not T²
+
+
+def _chunk_size(t: int) -> int:
+    """Largest standard chunk that divides t (power-of-two T_locals, the
+    practical case); t itself for small/indivisible lengths."""
+    for c in (512, 256, 128, 64):
+        if t > c and t % c == 0:
+            return c
+    return t
+
+
+def _block_attn(q, k, v, mask_fn=None, scale=None):
+    """One Q-block × K/V-block partial attention: returns (out_unnorm, m, l).
+
+    Scores accumulate in f32 on the MXU (operands stay in the input dtype —
+    bf16 K/V ride the ring at half the comm volume) and the Q axis is
+    processed in chunks, so the peak score block AND mask are (C, T_local),
+    never the (T_local, T_local) the round-3 version materialized.
+    ``mask_fn(q_start, q_len) -> (q_len, T) bool`` builds masks lazily per
+    chunk."""
     scale = scale or (1.0 / math.sqrt(q.shape[-1]))
-    s = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
-    if causal_mask is not None:
-        s = jnp.where(causal_mask, s, -1e30)
-    m = jnp.max(s, axis=-1)  # (..., h, q)
-    p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("...hqk,...khd->...qhd", p, v)
+    T = q.shape[1]
+    C = _chunk_size(T)
+
+    def one_chunk(qc, q_start):
+        s = jnp.einsum(
+            "...qhd,...khd->...hqk", qc, k, preferred_element_type=jnp.float32
+        ) * scale
+        if mask_fn is not None:
+            s = jnp.where(mask_fn(q_start, qc.shape[1])[None, None], s, -1e30)
+        m = jnp.max(s, axis=-1)  # (..., h, c)
+        p = jnp.exp(s - m[..., None])
+        l = jnp.sum(p, axis=-1)
+        o = jnp.einsum(
+            "...hqk,...khd->...qhd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        return o, m, l
+
+    if C == T:
+        return one_chunk(q, 0)
+    n = T // C
+    qs = jnp.moveaxis(q.reshape(q.shape[0], n, C, *q.shape[2:]), 1, 0)
+    o, m, l = lax.map(
+        lambda a: one_chunk(a[0], a[1] * C), (qs, jnp.arange(n))
+    )
+    # stitch chunks back: o is (n, B, C, H, D) -> (B, T, H, D); m/l are
+    # (n, B, H, C) -> (B, H, T)
+    o = jnp.moveaxis(o, 0, 1).reshape(q.shape[0], T, *q.shape[2:])
+    m = jnp.moveaxis(m, 0, -2).reshape(*m.shape[1:-1], T)
+    l = jnp.moveaxis(l, 0, -2).reshape(*l.shape[1:-1], T)
     return o, m, l
 
 
@@ -46,41 +88,59 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
     scale = 1.0 / math.sqrt(q.shape[-1])
     perm = [(i, (i - 1) % sp) for i in range(sp)]  # kv blocks rotate upstream
 
-    def make_mask(kv_idx):
+    def make_mask_fn(kv_idx):
         if not causal:
             return None
-        # global positions: q row r -> my_idx*t + r ; kv col c -> kv_idx*t + c
-        qpos = my_idx * t_local + jnp.arange(t_local)
-        kpos = kv_idx * t_local + jnp.arange(t_local)
-        return (qpos[:, None] >= kpos[None, :])[None, None]  # (1,1,q,k)
+
+        def mask_fn(q_start, q_len):
+            # global positions: q row r -> my_idx*t + q_start + r;
+            # kv col c -> kv_idx*t + c. Built lazily PER CHUNK: (q_len, T),
+            # never the full (T, T)
+            qpos = my_idx * t_local + q_start + jnp.arange(q_len)
+            kpos = kv_idx * t_local + jnp.arange(t_local)
+            return qpos[:, None] >= kpos[None, :]
+
+        return mask_fn
 
     def tick(carry, step):
         k_cur, v_cur, o_acc, m_acc, l_acc = carry
         kv_idx = (my_idx + step) % sp
-        mask = make_mask(kv_idx)
-        o_b, m_b, l_b = _block_attn(q, k_cur, v_cur, mask, scale)
-        m_new = jnp.maximum(m_acc, m_b)
-        alpha = jnp.exp(m_acc - m_new)
-        beta = jnp.exp(m_b - m_new)
-        # o accumulators are (..., q, h, d); m/l are (..., h, q)
-        o_acc = o_acc * jnp.swapaxes(alpha, -1, -2)[..., None] + o_b * jnp.swapaxes(beta, -1, -2)[..., None]
-        l_acc = l_acc * alpha + l_b * beta
+
+        def attend(carry_in):
+            o_acc, m_acc, l_acc = carry_in
+            o_b, m_b, l_b = _block_attn(q, k_cur, v_cur, make_mask_fn(kv_idx), scale)
+            m_new = jnp.maximum(m_acc, m_b)
+            alpha = jnp.exp(m_acc - m_new)
+            beta = jnp.exp(m_b - m_new)
+            # o accumulators are (..., q, h, d); m/l are (..., h, q)
+            o2 = o_acc * jnp.swapaxes(alpha, -1, -2)[..., None] + o_b * jnp.swapaxes(beta, -1, -2)[..., None]
+            return o2, m_new, l_acc * alpha + l_b * beta
+
+        if causal:
+            # a kv block strictly in the future is FULLY masked for every
+            # local q row — skip its T_local² of dead work entirely
+            o_acc, m_acc, l_acc = lax.cond(
+                kv_idx <= my_idx, attend, lambda c: c, (o_acc, m_acc, l_acc)
+            )
+        else:
+            o_acc, m_acc, l_acc = attend((o_acc, m_acc, l_acc))
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return (k_next, v_next, o_acc, m_new, l_acc), None
+        return (k_next, v_next, o_acc, m_acc, l_acc), None
 
     B, T, H, D = q.shape
     # accumulators derive from q so they carry the same device-varying type
-    # under shard_map (fresh constants would fail the scan carry check);
-    # causal fully-masked blocks are handled by the running-max algebra
-    # (alpha/beta → 0), no special-casing needed.
+    # under shard_map (fresh constants would fail the scan carry check).
+    # Causal fully-masked (future) kv blocks are SKIPPED via lax.cond in
+    # tick(); initial accumulators must therefore be valid "no keys seen yet"
+    # state (m=-inf, l=0), which they are.
     o0 = q.astype(jnp.float32) * 0.0
     zero_bht = jnp.swapaxes(q[..., 0].astype(jnp.float32), 1, 2) * 0.0  # (B,H,T)
     m0 = zero_bht - 1e30
     l0 = zero_bht
-    (k_f, v_f, o, m, l), _ = lax.scan(
-        tick, (k.astype(jnp.float32), v.astype(jnp.float32), o0, m0, l0), jnp.arange(sp)
-    )
+    # K/V rotate in their INPUT dtype: bf16 halves the per-tick ppermute
+    # volume vs the round-3 f32 carry (scores still accumulate in f32)
+    (k_f, v_f, o, m, l), _ = lax.scan(tick, (k, v, o0, m0, l0), jnp.arange(sp))
     out = o / jnp.maximum(jnp.swapaxes(l, -1, -2)[..., None], 1e-30)
     return out.astype(q.dtype)
 

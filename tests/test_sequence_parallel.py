@@ -159,3 +159,40 @@ def test_ulysses_no_txt_materialization():
     q = jnp.zeros((1, T, 2, 64), jnp.float32)
     txt = jax.jit(lambda a: _local_attention(a, a, a, True)).lower(q).as_text()
     assert not re.search(rf"{T}x{T}", txt), "TxT score tensor found in HLO"
+
+
+class TestRingChunkedAndDtype:
+    def test_chunked_q_path_parity(self):
+        """T_local > _Q_CHUNK exercises the chunked score path (peak score
+        block C x T_local, not T_local^2)."""
+        q, k, v = _qkv(7, B=1, T=2048, H=2, D=8)  # sp=2 -> T_local=1024 > 512
+        out = _spmd(
+            lambda a, b, c: ring_attention(a, b, c, "sp", causal=True), sp=2
+        )(q, k, v)
+        ref = exact_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=3e-5, atol=3e-5)
+
+    def test_kv_rotate_in_input_dtype(self, monkeypatch):
+        """bf16 K/V must ride the ring in bf16 (round-3 carried f32: 2x comm)."""
+        from jax import lax as jlax
+        from paddle_tpu.distributed.fleet.meta_parallel import sequence_parallel as spm
+
+        q, k, v = _qkv(8)
+        qb = jnp.asarray(q, jnp.bfloat16)
+        kb = jnp.asarray(k, jnp.bfloat16)
+        vb = jnp.asarray(v, jnp.bfloat16)
+        seen = []
+        orig = jlax.ppermute
+
+        def spy(x, axis_name, perm):
+            seen.append(x.dtype)
+            return orig(x, axis_name, perm)
+
+        class LaxProxy:
+            def __getattr__(self, name):
+                return spy if name == "ppermute" else getattr(jlax, name)
+
+        monkeypatch.setattr(spm, "lax", LaxProxy())
+        out = _spmd(lambda a, b, c: ring_attention(a, b, c, "sp", causal=False))(qb, kb, vb)
+        assert seen and all(dt == jnp.bfloat16 for dt in seen), seen
+        assert out.dtype == jnp.bfloat16
