@@ -27,11 +27,14 @@ _Q_CHUNK = 512  # per-chunk score block is (C, T_local): memory âˆ CÂ·T, not TÂ
 
 
 def _chunk_size(t: int) -> int:
-    """Largest standard chunk that divides t (power-of-two T_locals, the
-    practical case); t itself for small/indivisible lengths."""
-    for c in (512, 256, 128, 64):
+    """Largest chunk â‰¤ _Q_CHUNK (halving ladder) that divides t â€” covers the
+    power-of-two T_locals of practice; t itself for small/indivisible
+    lengths (single chunk, no map)."""
+    c = _Q_CHUNK
+    while c >= 64:
         if t > c and t % c == 0:
             return c
+        c //= 2
     return t
 
 
