@@ -121,6 +121,7 @@ def distributed_optimizer(optimizer, strategy=None):
                 momentum=getattr(optimizer, "_momentum", 0.9),
                 parameters=optimizer._parameter_list,
                 rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                rampup_step=cfg.get("rampup_step", 1),
                 sparsity=cfg.get("sparsity", (0.999,)),
             )
     if getattr(strategy, "localsgd", False):

@@ -37,11 +37,12 @@ class DGCMomentumOptimizer:
         self._momentum = float(momentum)
         self._parameter_list = list(parameters) if parameters is not None else []
         self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
         self._sparsity = tuple(sparsity) if isinstance(sparsity, (list, tuple)) else (float(sparsity),)
         self.axis_name = axis_name or (group.axis_name if group is not None else "dp")
         self._step_count = 0
-        self._u = {}  # id(param) -> velocity
-        self._v = {}  # id(param) -> error-feedback accumulator
+        self._u = {}  # param name -> velocity
+        self._v = {}  # param name -> error-feedback accumulator
         # observability: fraction of elements communicated last step
         self.last_comm_fraction = 1.0
 
@@ -58,14 +59,20 @@ class DGCMomentumOptimizer:
 
     def step(self):
         lr = self.get_lr()
-        sparsity = self._sparsity[min(len(self._sparsity) - 1, max(0, self._step_count - self._rampup_begin))] \
-            if self._step_count >= self._rampup_begin else None
+        if self._step_count >= self._rampup_begin:
+            # reference schedule (optimizer.py:1571): each sparsity rung is
+            # held for rampup_step/len(sparsity) steps, clamped to the last
+            idx = ((self._step_count - self._rampup_begin) * len(self._sparsity)
+                   // self._rampup_step)
+            sparsity = self._sparsity[min(len(self._sparsity) - 1, idx)]
+        else:
+            sparsity = None
         total = kept = 0
         for p in self._parameter_list:
             if p.grad is None or p.stop_gradient:
                 continue
             g = p.grad._data
-            key = id(p)
+            key = p.name
             if self._step_count < self._rampup_begin:
                 # dense ramp-up: plain distributed momentum
                 g = self._pmean(g)
@@ -98,6 +105,23 @@ class DGCMomentumOptimizer:
     def state_dict(self):
         return {
             "step": self._step_count,
-            "u": {i: _concrete(a) for i, a in enumerate(self._u.values())},
-            "v": {i: _concrete(a) for i, a in enumerate(self._v.values())},
+            "u": {k: _concrete(a) for k, a in self._u.items()},
+            "v": {k: _concrete(a) for k, a in self._v.items()},
         }
+
+    def set_state_dict(self, state):
+        # a key that matches no parameter would silently restart that
+        # parameter's velocity/error-feedback from zero — fail loudly instead
+        names = {p.name for p in self._parameter_list}
+        for part in ("u", "v"):
+            stale = set(state.get(part, {})) - names
+            if stale:
+                raise ValueError(
+                    f"DGC state_dict {part!r} keys {sorted(stale)} match no "
+                    f"parameter of this optimizer (have {sorted(names)}); "
+                    "checkpoints from the old integer-keyed format cannot be "
+                    "restored"
+                )
+        self._step_count = int(state.get("step", 0))
+        self._u = {k: jnp.asarray(a) for k, a in state.get("u", {}).items()}
+        self._v = {k: jnp.asarray(a) for k, a in state.get("v", {}).items()}

@@ -32,7 +32,7 @@ def _chunk_size(t: int) -> int:
     lengths (single chunk, no map)."""
     c = _Q_CHUNK
     while c >= 64:
-        if t > c and t % c == 0:
+        if t >= c and t % c == 0:
             return c
         c //= 2
     return t

@@ -59,6 +59,7 @@ def sharded_host_embedding(num_embeddings, embedding_dim, store=None, **kw):
         num_embeddings, embedding_dim, store=store, rank=rank, world_size=world,
         optimizer=kw.pop("optimizer", "sgd"), init_std=kw.pop("init_std", 0.01),
         seed=kw.pop("seed", 0), path=kw.pop("path", None),
+        name=kw.pop("name", None),
     )
     return HostEmbedding(num_embeddings, embedding_dim, table=table)
 
@@ -207,15 +208,25 @@ class ShardedHostEmbeddingTable:
     """
 
     CHUNK = 512 * 1024
+    # per-process construction counter: ranks build their tables in the same
+    # program order, so the index is a deterministic cross-rank identity
+    _instance_counter = 0
 
     def __init__(self, num_embeddings, embedding_dim, store, rank, world_size,
                  dtype="float32", path=None, init_std=0.01, seed=0,
-                 optimizer="sgd", adagrad_eps=1e-8):
+                 optimizer="sgd", adagrad_eps=1e-8, name=None):
         self.num_embeddings = int(num_embeddings)
         self.embedding_dim = int(embedding_dim)
         self.rank = int(rank)
         self.world_size = int(world_size)
         self.store = store
+        # namespace every store key by table identity: two tables sharing one
+        # TCPStore each count gens from 0, and without this a fast rank's
+        # table-2 request could be consumed as a peer's table-1 traffic
+        idx = ShardedHostEmbeddingTable._instance_counter
+        ShardedHostEmbeddingTable._instance_counter += 1
+        self.name = name if name is not None else f"t{idx}"
+        self._prefix = f"he/{self.name}"
         # local shard holds global ids {rank, rank+world, rank+2*world, …}
         n_local = (self.num_embeddings - self.rank + self.world_size - 1) // self.world_size
         self.local = HostEmbeddingTable(
@@ -271,7 +282,7 @@ class ShardedHostEmbeddingTable:
             if o == self.rank:
                 continue
             want = ids[owner == o]
-            self._put(f"he/{gen}/req/{self.rank}/{o}", want.tobytes())
+            self._put(f"{self._prefix}/{gen}/req/{self.rank}/{o}", want.tobytes())
         mine = ids[owner == self.rank]
         if mine.size:
             out[owner == self.rank] = self.local.gather(mine // self.world_size)
@@ -279,14 +290,14 @@ class ShardedHostEmbeddingTable:
         for r in range(self.world_size):
             if r == self.rank:
                 continue
-            req = np.frombuffer(self._take(f"he/{gen}/req/{r}/{self.rank}"), np.int64)
+            req = np.frombuffer(self._take(f"{self._prefix}/{gen}/req/{r}/{self.rank}"), np.int64)
             rows = self.local.gather(req // self.world_size) if req.size else np.empty((0, self.embedding_dim), np.float32)
-            self._put(f"he/{gen}/rep/{self.rank}/{r}", np.ascontiguousarray(rows, np.float32).tobytes())
+            self._put(f"{self._prefix}/{gen}/rep/{self.rank}/{r}", np.ascontiguousarray(rows, np.float32).tobytes())
         # 3. read replies
         for o in range(self.world_size):
             if o == self.rank:
                 continue
-            rows = np.frombuffer(self._take(f"he/{gen}/rep/{o}/{self.rank}"), np.float32)
+            rows = np.frombuffer(self._take(f"{self._prefix}/{gen}/rep/{o}/{self.rank}"), np.float32)
             out[owner == o] = rows.reshape(-1, self.embedding_dim)
         return out
 
@@ -303,15 +314,15 @@ class ShardedHostEmbeddingTable:
             if o == self.rank:
                 continue
             sel = owner == o
-            self._put(f"he/{gen}/gid/{self.rank}/{o}", ids[sel].tobytes())
-            self._put(f"he/{gen}/g/{self.rank}/{o}", np.ascontiguousarray(grad[sel]).tobytes())
+            self._put(f"{self._prefix}/{gen}/gid/{self.rank}/{o}", ids[sel].tobytes())
+            self._put(f"{self._prefix}/{gen}/g/{self.rank}/{o}", np.ascontiguousarray(grad[sel]).tobytes())
         all_ids = [ids[owner == self.rank]]
         all_grads = [grad[owner == self.rank]]
         for r in range(self.world_size):
             if r == self.rank:
                 continue
-            gi = np.frombuffer(self._take(f"he/{gen}/gid/{r}/{self.rank}"), np.int64)
-            gg = np.frombuffer(self._take(f"he/{gen}/g/{r}/{self.rank}"), np.float32).reshape(-1, self.embedding_dim)
+            gi = np.frombuffer(self._take(f"{self._prefix}/{gen}/gid/{r}/{self.rank}"), np.int64)
+            gg = np.frombuffer(self._take(f"{self._prefix}/{gen}/g/{r}/{self.rank}"), np.float32).reshape(-1, self.embedding_dim)
             all_ids.append(gi)
             all_grads.append(gg)
         uniq, merged = _merge_sparse_grads(all_ids, all_grads, self.embedding_dim)
@@ -413,6 +424,17 @@ class HostEmbedding(Layer):
         # push must still participate (empty payload), or peers deadlock in
         # store.wait() and the _gen counters diverge
         dim = self.table.embedding_dim
+        # adagrad's accumulator is step-count sensitive: one update with the
+        # summed grad != one update per microbatch. For a LOCAL table the
+        # coalescing buys nothing (no comm round), so keep per-microbatch
+        # semantics there; the sharded table coalesces (one pull/push round)
+        # and documents the summed-grad semantics as the distributed contract.
+        if not sharded and getattr(self.table, "optimizer", "sgd") == "adagrad":
+            with self._table_lock:
+                for ids_i, grad_i in zip(ids_list, grad_list):
+                    self.table.apply_update(ids_i, grad_i, lr)
+            self._prefetched = None
+            return
         uniq, merged = _merge_sparse_grads(ids_list, grad_list, dim)
         if uniq.size == 0 and not sharded:
             return
