@@ -1,13 +1,128 @@
 """paddle.distributed.spawn parity.
 
-Reference: ``python/paddle/distributed/spawn.py`` — fork N single-GPU
-processes. TPU-native single-controller runtime: one process drives all
-chips, so spawn() runs the function once with the full mesh; multihost
-launches go through paddle_tpu.distributed.launch (one process per host).
+Reference: ``python/paddle/distributed/spawn.py`` — fork N worker processes,
+set per-rank PADDLE_* env, run ``func`` in each, join and re-raise failures.
+
+TPU-native shape: a real TPU pod is driven one-process-per-HOST via
+``paddle_tpu.distributed.launch`` (single-controller per host), so spawn's
+job here is the single-host multi-process development path: N CPU-backend
+``jax.distributed`` processes on one machine — the same world the reference
+builds with one GPU per process. Each child gets PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / PADDLE_TPU_COORDINATOR so ``init_parallel_env()``
+inside ``func`` forms the collective world.
 """
 from __future__ import annotations
 
+import multiprocessing as mp
+import os
+import socket
 
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker(func, args):
+    # env is inherited from the parent's per-rank os.environ snapshot (set
+    # around p.start()): it must be in place BEFORE this function body runs,
+    # because unpickling the target itself imports paddle_tpu (and jax).
     func(*args)
-    return None
+
+
+class SpawnContext:
+    def __init__(self, procs):
+        self.processes = procs
+
+    def join(self, timeout=None):
+        """Wait for all workers, POLLING so one crashed rank is detected even
+        while its peers sit blocked in a collective waiting for it — the rest
+        are then terminated and the failure raised (the reference's
+        watch-and-kill loop in spawn.py)."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            bad = [(r, p.exitcode) for r, p in enumerate(self.processes)
+                   if p.exitcode not in (0, None)]
+            if bad:
+                for p in self.processes:  # one failure sinks the job
+                    if p.is_alive():
+                        p.terminate()
+                for p in self.processes:
+                    p.join(5)
+                rank, code = bad[0]
+                raise RuntimeError(
+                    f"spawn worker rank {rank} exited with code {code} "
+                    f"({len(bad)} of {len(self.processes)} workers failed)"
+                )
+            alive = [p for p in self.processes if p.exitcode is None]
+            if not alive:
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            alive[0].join(0.2)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
+          **options):
+    """Run ``func(*args)`` in ``nprocs`` processes forming one collective
+    world. ``nprocs<=1`` runs inline (single-controller fast path). Children
+    default to the CPU backend: one host has one TPU client, and N processes
+    contending for it is never what a multi-process dev run means — multihost
+    TPU launches go through ``paddle_tpu.distributed.launch`` instead."""
+    if nprocs in (-1, 0):
+        nprocs = 1
+    if nprocs <= 1:
+        func(*args)
+        return None if join else SpawnContext([])
+    coordinator = f"127.0.0.1:{_free_port()}"
+    if backend is None:
+        backend = "cpu"
+    ctx = mp.get_context("spawn")
+    procs = []
+    # Children must see the worker env BEFORE their first import: unpickling
+    # the process target imports paddle_tpu (and thus jax), so env set inside
+    # the child function body is too late. Mutate os.environ around each
+    # p.start() (children snapshot it at exec) and restore after.
+    child_env = {
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_TPU_COORDINATOR": coordinator,
+        "JAX_PLATFORMS": backend,
+    }
+    child_env.update(options.get("env", {}))
+    # strip sitecustomize dirs from the children's PYTHONPATH: a
+    # sitecustomize that eagerly imports jax (TPU tunnel images) creates the
+    # backend client at interpreter startup, turning the worker's
+    # jax.distributed.initialize into a no-op (world collapses to 1). Module
+    # imports in children are unaffected — multiprocessing ships the parent's
+    # sys.path explicitly.
+    old_pp = os.environ.get("PYTHONPATH")
+    if old_pp is not None and "PYTHONPATH" not in child_env:
+        # an explicit env={'PYTHONPATH': ...} override wins over the strip
+        child_env["PYTHONPATH"] = os.pathsep.join(
+            p for p in old_pp.split(os.pathsep)
+            if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))
+        )
+    saved = {k: os.environ.get(k) for k in (*child_env, "PADDLE_TRAINER_ID",
+                                            "PADDLE_LOCAL_RANK")}
+    try:
+        os.environ.update(child_env)
+        for rank in range(nprocs):
+            os.environ["PADDLE_TRAINER_ID"] = str(rank)
+            os.environ["PADDLE_LOCAL_RANK"] = str(rank)
+            p = ctx.Process(target=_worker, args=(func, args), daemon=daemon)
+            p.start()
+            procs.append(p)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    context = SpawnContext(procs)
+    if join:
+        context.join()
+        return None
+    return context

@@ -29,3 +29,23 @@ def _seed():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
+
+
+# Smoke tier: `pytest -m smoke` runs a <60s cross-section (tensor ops,
+# autograd engine, lazy batching, regression pins) — the always-run gate;
+# the full suite is the per-round regression sweep.
+_SMOKE_MODULES = {
+    "test_tensor_ops", "test_autograd", "test_lazy", "test_regressions",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "smoke: fast cross-section of the suite (<60s total)"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _SMOKE_MODULES:
+            item.add_marker(pytest.mark.smoke)

@@ -164,6 +164,55 @@ class TestElastic:
         store.close()
 
 
+def _spawn_worker(out_dir):
+    # runs in a fresh spawn()ed process: one CPU device per rank so the
+    # cross-process psum result is just sum(rank+1)
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    from paddle_tpu.distributed import parallel_env
+
+    env = parallel_env.init_parallel_env()
+    import jax
+    import jax.numpy as jnp
+
+    out = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+        jnp.ones((jax.local_device_count(),)) * (env.rank + 1)
+    )
+    expected = sum(r + 1 for r in range(env.world_size))
+    assert float(out[0]) == expected, (float(out[0]), expected)
+    with open(os.path.join(out_dir, f"rank_{env.rank}"), "w") as f:
+        f.write(f"{env.world_size}")
+
+
+class TestSpawn:
+    def test_spawn_two_process_collective(self, tmp_path):
+        from paddle_tpu.distributed.spawn import spawn
+
+        spawn(_spawn_worker, args=(str(tmp_path),), nprocs=2)
+        assert (tmp_path / "rank_0").read_text() == "2"
+        assert (tmp_path / "rank_1").read_text() == "2"
+
+    def test_spawn_inline_single(self, tmp_path):
+        from paddle_tpu.distributed.spawn import spawn
+
+        marker = []
+        spawn(lambda: marker.append(1), nprocs=1)
+        assert marker == [1]
+
+    def test_spawn_propagates_worker_failure(self):
+        from paddle_tpu.distributed.spawn import spawn
+
+        with pytest.raises(RuntimeError, match="rank"):
+            spawn(_failing_worker, nprocs=2)
+
+
+def _failing_worker():
+    import sys
+
+    sys.exit(3)
+
+
 class TestLauncher:
     def test_cluster_topology(self):
         from paddle_tpu.distributed.launch_mod import get_cluster
