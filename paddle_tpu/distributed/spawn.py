@@ -115,6 +115,16 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
             p = ctx.Process(target=_worker, args=(func, args), daemon=daemon)
             p.start()
             procs.append(p)
+    except BaseException:
+        # a failed start() mid-loop must not orphan earlier ranks — they sit
+        # blocked in the jax.distributed rendezvous for a world that will
+        # never form
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for p in procs:
+            p.join(5)
+        raise
     finally:
         for k, v in saved.items():
             if v is None:

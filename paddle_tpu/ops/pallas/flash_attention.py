@@ -128,6 +128,185 @@ def _resident_ok(t_side: int, d: int, dtype) -> bool:
     return 2 * t_side * d * jnp.dtype(dtype).itemsize <= _RESIDENT_BYTES
 
 
+# -- MULTI-ROW resident kernels (A/B: LOSES — kept behind a flag) ------------
+# Hypothesis (round 5): per-program overhead at short T (each (b·h, q-block)
+# program runs ~2 small (BQ,BK)·D matmuls) capped the kernel at ~27 TF/s,
+# since the same matmul chain hits ~95 TF/s with 8 chunks per program at
+# T=4096. These kernels batch ROWS (b·h pairs) per program to amortize it.
+# MEASURED A/B at (B=8,H=16,T=1024,D=64) bf16, 24-layer chain, v5e:
+#   single-row  fwd 1.118 ms/layer   fwd+bwd 1.998 ms/layer
+#   rows=8/4    fwd 1.250 ms/layer   fwd+bwd 2.148 ms/layer   <- LOSES ~7%
+#   (also tried: chunk-outer/rows-inner with one fori per program: 1.41-1.53;
+#    static-unrolled row loop: 0.98; native (B,T,H·D) two-pass layout: 2.09)
+# The per-program-overhead theory did not survive contact: the win at long T
+# comes from fori steady-state, which row batching does not create. Flag kept
+# so the A/B is reproducible.
+_MULTI_ROW = False
+
+def _pick_rows(bh: int, t: int, d: int, dtype, arrays: int, budget=10 * 1024 * 1024) -> int:
+    """Rows per program: largest R | bh with `arrays` resident (T, D) buffers
+    (double-buffered) under the VMEM budget."""
+    es = jnp.dtype(dtype).itemsize
+    for r in (8, 4, 2):
+        if bh % r == 0 and arrays * r * t * d * es * 2 <= budget:
+            return r
+    return 1
+
+
+def _fwd_kernel_multi(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float, t_kv: int, kv_len: int, rows: int):
+    # q/o: (R, BQ, D); k/v: (R, T, D); lse: (R, 1, BQ)
+    iq = pl.program_id(1)
+    bq = q_ref.shape[1]
+    d = q_ref.shape[2]
+    _PREC = _prec(q_ref.dtype)
+    n_kb = t_kv // block_k
+    if causal and bq == block_k:
+        last_kb = jnp.minimum(iq + 1, n_kb)
+    else:
+        last_kb = n_kb
+
+    def row(r, _):
+        q = q_ref[r]  # (BQ, D)
+
+        def body(kb, carry):
+            m, l, acc = carry
+            k_blk = k_ref[r, pl.ds(kb * block_k, block_k), :]
+            v_blk = v_ref[r, pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+            ) * jnp.float32(scale)
+            if causal or kv_len < t_kv:
+                q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+                valid = k_pos < kv_len
+                if causal:
+                    valid = valid & (q_pos >= k_pos)
+                s = jnp.where(valid, s, jnp.float32(_NEG_INF))
+            m_new = jnp.maximum(m, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )
+            return m_new, l, acc
+
+        m, l, acc = jax.lax.fori_loop(
+            0, last_kb, body,
+            (jnp.full((bq,), _NEG_INF, jnp.float32), jnp.zeros((bq,), jnp.float32),
+             jnp.zeros((bq, d), jnp.float32)),
+        )
+        l_safe = jnp.maximum(l, jnp.float32(1e-30))
+        o_ref[r] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[r, 0, :] = m + jnp.log(l_safe)
+        return 0
+
+    jax.lax.fori_loop(0, rows, row, 0)
+
+
+def _dq_kernel_multi(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k: int, causal: bool, scale: float, t_kv: int, kv_len: int, rows: int):
+    # q/do/dq: (R, BQ, D); k/v: (R, T, D); lse/delta: (R, 1, BQ)
+    iq = pl.program_id(1)
+    bq = q_ref.shape[1]
+    d = q_ref.shape[2]
+    _PREC = _prec(q_ref.dtype)
+    n_kb = t_kv // block_k
+    if causal and bq == block_k:
+        last_kb = jnp.minimum(iq + 1, n_kb)
+    else:
+        last_kb = n_kb
+
+    def row(r, _):
+        q = q_ref[r]
+        do = do_ref[r]
+        lse = lse_ref[r, 0, :]
+        delta = delta_ref[r, 0, :]
+
+        def body(kb, acc):
+            k_blk = k_ref[r, pl.ds(kb * block_k, block_k), :]
+            v_blk = v_ref[r, pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+            ) * jnp.float32(scale)
+            if causal or kv_len < t_kv:
+                q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+                valid = k_pos < kv_len
+                if causal:
+                    valid = valid & (q_pos >= k_pos)
+                s = jnp.where(valid, s, jnp.float32(_NEG_INF))
+            p = jnp.exp(s - lse[:, None])
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+            )
+            ds = p * (dp - delta[:, None])
+            return acc + jax.lax.dot_general(
+                ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )
+
+        acc = jax.lax.fori_loop(0, last_kb, body, jnp.zeros((bq, d), jnp.float32))
+        dq_ref[r] = (acc * jnp.float32(scale)).astype(dq_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, rows, row, 0)
+
+
+def _dkv_kernel_multi(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float, t_q: int, kv_len: int, rows: int):
+    # k/v/dk/dv: (R, BK, D); q/do: (R, T, D); lse/delta: (R, 1, T)
+    ik = pl.program_id(1)
+    bk = k_ref.shape[1]
+    d = k_ref.shape[2]
+    _PREC = _prec(k_ref.dtype)
+    n_qb = t_q // block_q
+    first_qb = ik if (causal and bk == block_q) else 0
+
+    def row(r, _):
+        k_blk = k_ref[r]  # (BK, D)
+        v_blk = v_ref[r]
+
+        def body(qb, carry):
+            dk, dv = carry
+            qq = q_ref[r, pl.ds(qb * block_q, block_q), :]
+            do = do_ref[r, pl.ds(qb * block_q, block_q), :]
+            lse = lse_ref[r, 0, pl.ds(qb * block_q, block_q)]
+            delta = delta_ref[r, 0, pl.ds(qb * block_q, block_q)]
+            s = jax.lax.dot_general(
+                qq, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+            ) * jnp.float32(scale)
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            valid = k_pos < kv_len
+            if causal:
+                valid = valid & (q_pos >= k_pos)
+            s = jnp.where(valid, s, jnp.float32(_NEG_INF))
+            p = jnp.exp(s - lse[:, None])
+            dv = dv + jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+            )
+            ds = p * (dp - delta[:, None]) * jnp.float32(scale)
+            dk = dk + jax.lax.dot_general(
+                ds.astype(qq.dtype), qq, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )
+            return dk, dv
+
+        dk, dv = jax.lax.fori_loop(
+            first_qb, n_qb, body,
+            (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
+        )
+        dk_ref[r] = dk.astype(dk_ref.dtype)
+        dv_ref[r] = dv.astype(dv_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, rows, row, 0)
+
+
 def _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len):
     bh, t, d = q.shape
     t_kv = k.shape[1]
@@ -135,6 +314,30 @@ def _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len):
     n_kv = t_kv // block_k
 
     if _resident_ok(t_kv, d, k.dtype):
+        rows = _pick_rows(bh, t_kv, d, k.dtype, arrays=2)  # K+V resident
+        if _MULTI_ROW and rows > 1 and t == t_kv:
+            out, lse = pl.pallas_call(
+                functools.partial(
+                    _fwd_kernel_multi, block_k=block_k, causal=causal,
+                    scale=scale, t_kv=t_kv, kv_len=kv_len, rows=rows,
+                ),
+                grid=(bh // rows, t // block_q),
+                in_specs=[
+                    pl.BlockSpec((rows, block_q, d), lambda b, i: (b, i, 0)),
+                    pl.BlockSpec((rows, t_kv, d), lambda b, i: (b, 0, 0)),
+                    pl.BlockSpec((rows, t_kv, d), lambda b, i: (b, 0, 0)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((rows, block_q, d), lambda b, i: (b, i, 0)),
+                    pl.BlockSpec((rows, 1, block_q), lambda b, i: (b, 0, i)),
+                ],
+                out_shape=[
+                    jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                    jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+                ],
+                interpret=interpret,
+            )(q, k, v)
+            return out, lse
         out, lse = pl.pallas_call(
             functools.partial(
                 _fwd_kernel_resident, block_k=block_k, causal=causal,
@@ -347,6 +550,278 @@ def _dkv_kernel_resident(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+# -- NATIVE-LAYOUT (B, T, H·D) resident kernels -------------------------------
+# The (B,T,H,D)→(B·H,T,D) swapaxes around the BH kernels are real per-layer
+# HBM transposes in a model (each layer has its own k/v — XLA cannot hoist
+# them the way a k/v-reusing microbenchmark lets it). These kernels read the
+# contiguous (B, T, H·D) view (a FREE reshape of the paddle layout — exactly
+# what the QKV projection emits) with `hp` heads per program so the lane
+# width hp·D tiles the 128-lane axis (hp=2 for D=64). Softmax is two-pass
+# against a VMEM score scratch: pass A writes score chunks and the true row
+# max, pass B does exp exactly once — no per-chunk accumulator rescaling.
+
+def _fwd_kernel_hd(q_ref, k_ref, v_ref, o_ref, lse_ref, s_sc, *, block_k: int, causal: bool, scale: float, t_kv: int, kv_len: int, d: int, hp: int):
+    # q/o: (1, BQ, hp·D); k/v: (1, T, hp·D); lse: (1, 1, hp, BQ); s_sc: (BQ, T) f32
+    iq = pl.program_id(2)
+    bq = q_ref.shape[1]
+    _PREC = _prec(q_ref.dtype)
+    n_kb = t_kv // block_k
+    if causal and bq == block_k:
+        last_kb = jnp.minimum(iq + 1, n_kb)
+    else:
+        last_kb = n_kb
+
+    for hi in range(hp):
+        q = q_ref[0, :, hi * d:(hi + 1) * d]  # (BQ, D)
+
+        def pass_a(kb, m, _q=q):
+            k_blk = k_ref[0, pl.ds(kb * block_k, block_k), hi * d:(hi + 1) * d]
+            s = jax.lax.dot_general(
+                _q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+            ) * jnp.float32(scale)  # (BQ, BK)
+            if causal or kv_len < t_kv:
+                q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+                valid = k_pos < kv_len
+                if causal:
+                    valid = valid & (q_pos >= k_pos)
+                s = jnp.where(valid, s, jnp.float32(_NEG_INF))
+            s_sc[:, pl.ds(kb * block_k, block_k)] = s
+            return jnp.maximum(m, jnp.max(s, axis=1))
+
+        m = jax.lax.fori_loop(0, last_kb, pass_a, jnp.full((bq,), _NEG_INF, jnp.float32))
+
+        def pass_b(kb, carry):
+            l, acc = carry
+            v_blk = v_ref[0, pl.ds(kb * block_k, block_k), hi * d:(hi + 1) * d]
+            p = jnp.exp(s_sc[:, pl.ds(kb * block_k, block_k)] - m[:, None])
+            l = l + jnp.sum(p, axis=1)
+            acc = acc + jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )
+            return l, acc
+
+        l, acc = jax.lax.fori_loop(
+            0, last_kb, pass_b,
+            (jnp.zeros((bq,), jnp.float32), jnp.zeros((bq, d), jnp.float32)),
+        )
+        l_safe = jnp.maximum(l, jnp.float32(1e-30))
+        o_ref[0, :, hi * d:(hi + 1) * d] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0, hi, :] = m + jnp.log(l_safe)
+
+
+def _dq_kernel_hd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k: int, causal: bool, scale: float, t_kv: int, kv_len: int, d: int, hp: int):
+    # q/do/dq: (1, BQ, hp·D); k/v: (1, T, hp·D); lse/delta: (1, 1, hp, BQ)
+    iq = pl.program_id(2)
+    bq = q_ref.shape[1]
+    _PREC = _prec(q_ref.dtype)
+    n_kb = t_kv // block_k
+    if causal and bq == block_k:
+        last_kb = jnp.minimum(iq + 1, n_kb)
+    else:
+        last_kb = n_kb
+
+    for hi in range(hp):
+        q = q_ref[0, :, hi * d:(hi + 1) * d]
+        do = do_ref[0, :, hi * d:(hi + 1) * d]
+        lse = lse_ref[0, 0, hi, :]
+        delta = delta_ref[0, 0, hi, :]
+
+        def body(kb, acc, _q=q, _do=do, _lse=lse, _delta=delta):
+            k_blk = k_ref[0, pl.ds(kb * block_k, block_k), hi * d:(hi + 1) * d]
+            v_blk = v_ref[0, pl.ds(kb * block_k, block_k), hi * d:(hi + 1) * d]
+            s = jax.lax.dot_general(
+                _q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+            ) * jnp.float32(scale)
+            if causal or kv_len < t_kv:
+                q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+                valid = k_pos < kv_len
+                if causal:
+                    valid = valid & (q_pos >= k_pos)
+                s = jnp.where(valid, s, jnp.float32(_NEG_INF))
+            p = jnp.exp(s - _lse[:, None])
+            dp = jax.lax.dot_general(
+                _do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+            )
+            ds = p * (dp - _delta[:, None])
+            return acc + jax.lax.dot_general(
+                ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )
+
+        acc = jax.lax.fori_loop(0, last_kb, body, jnp.zeros((bq, d), jnp.float32))
+        dq_ref[0, :, hi * d:(hi + 1) * d] = (acc * jnp.float32(scale)).astype(dq_ref.dtype)
+
+
+def _dkv_kernel_hd(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float, t_q: int, kv_len: int, d: int, hp: int):
+    # k/v/dk/dv: (1, BK, hp·D); q/do: (1, T, hp·D); lse/delta: (1, 1, hp, T)
+    ik = pl.program_id(2)
+    bk = k_ref.shape[1]
+    _PREC = _prec(k_ref.dtype)
+    n_qb = t_q // block_q
+    first_qb = ik if (causal and bk == block_q) else 0
+
+    for hi in range(hp):
+        k_blk = k_ref[0, :, hi * d:(hi + 1) * d]  # (BK, D)
+        v_blk = v_ref[0, :, hi * d:(hi + 1) * d]
+
+        def body(qb, carry, _k=k_blk, _v=v_blk):
+            dk, dv = carry
+            qq = q_ref[0, pl.ds(qb * block_q, block_q), hi * d:(hi + 1) * d]
+            do = do_ref[0, pl.ds(qb * block_q, block_q), hi * d:(hi + 1) * d]
+            lse = lse_ref[0, 0, hi, pl.ds(qb * block_q, block_q)]
+            delta = delta_ref[0, 0, hi, pl.ds(qb * block_q, block_q)]
+            s = jax.lax.dot_general(
+                qq, _k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+            ) * jnp.float32(scale)  # (BQ, BK)
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            valid = k_pos < kv_len
+            if causal:
+                valid = valid & (q_pos >= k_pos)
+            s = jnp.where(valid, s, jnp.float32(_NEG_INF))
+            p = jnp.exp(s - lse[:, None])
+            dv = dv + jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )
+            dp = jax.lax.dot_general(
+                do, _v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+            )
+            ds = p * (dp - delta[:, None]) * jnp.float32(scale)
+            dk = dk + jax.lax.dot_general(
+                ds.astype(qq.dtype), qq, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )
+            return dk, dv
+
+        dk, dv = jax.lax.fori_loop(
+            first_qb, n_qb, body,
+            (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
+        )
+        dk_ref[0, :, hi * d:(hi + 1) * d] = dk.astype(dk_ref.dtype)
+        dv_ref[0, :, hi * d:(hi + 1) * d] = dv.astype(dv_ref.dtype)
+
+
+def _flash_hd_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len, d, hp):
+    b, t, hd = q.shape
+    t_kv = k.shape[1]
+    g = hd // (hp * d)
+    w = hp * d
+    scale = 1.0 / math.sqrt(d)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel_hd, block_k=block_k, causal=causal, scale=scale,
+            t_kv=t_kv, kv_len=kv_len, d=d, hp=hp,
+        ),
+        grid=(b, g, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, w), lambda bb, gg, i: (bb, i, gg)),
+            pl.BlockSpec((1, t_kv, w), lambda bb, gg, i: (bb, 0, gg)),
+            pl.BlockSpec((1, t_kv, w), lambda bb, gg, i: (bb, 0, gg)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, w), lambda bb, gg, i: (bb, i, gg)),
+            pl.BlockSpec((1, 1, hp, block_q), lambda bb, gg, i: (bb, gg, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, hd), q.dtype),
+            jax.ShapeDtypeStruct((b, g, hp, t), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_q, t_kv), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _flash_hd_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret, kv_len, d, hp):
+    b, t, hd = q.shape
+    t_kv = k.shape[1]
+    h = hd // d
+    g = h // hp
+    w = hp * d
+    scale = 1.0 / math.sqrt(d)
+    # delta_i = dO_i · O_i per head, laid out (B, G, hp, T): rows on lanes
+    delta = jnp.transpose(
+        jnp.sum(
+            (do.astype(jnp.float32) * out.astype(jnp.float32)).reshape(b, t, g, hp, d),
+            axis=-1,
+        ),
+        (0, 2, 3, 1),
+    )
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel_hd, block_k=block_k, causal=causal, scale=scale, t_kv=t_kv, kv_len=kv_len, d=d, hp=hp),
+        grid=(b, g, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, w), lambda bb, gg, i: (bb, i, gg)),
+            pl.BlockSpec((1, t_kv, w), lambda bb, gg, i: (bb, 0, gg)),
+            pl.BlockSpec((1, t_kv, w), lambda bb, gg, i: (bb, 0, gg)),
+            pl.BlockSpec((1, block_q, w), lambda bb, gg, i: (bb, i, gg)),
+            pl.BlockSpec((1, 1, hp, block_q), lambda bb, gg, i: (bb, gg, 0, i)),
+            pl.BlockSpec((1, 1, hp, block_q), lambda bb, gg, i: (bb, gg, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, w), lambda bb, gg, i: (bb, i, gg)),
+        out_shape=jax.ShapeDtypeStruct((b, t, hd), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel_hd, block_q=block_q, causal=causal, scale=scale, t_q=t, kv_len=kv_len, d=d, hp=hp),
+        grid=(b, g, t_kv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, w), lambda bb, gg, j: (bb, j, gg)),
+            pl.BlockSpec((1, block_k, w), lambda bb, gg, j: (bb, j, gg)),
+            pl.BlockSpec((1, t, w), lambda bb, gg, j: (bb, 0, gg)),
+            pl.BlockSpec((1, t, w), lambda bb, gg, j: (bb, 0, gg)),
+            pl.BlockSpec((1, 1, hp, t), lambda bb, gg, j: (bb, gg, 0, 0)),
+            pl.BlockSpec((1, 1, hp, t), lambda bb, gg, j: (bb, gg, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, w), lambda bb, gg, j: (bb, j, gg)),
+            pl.BlockSpec((1, block_k, w), lambda bb, gg, j: (bb, j, gg)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t_kv, hd), k.dtype),
+            jax.ShapeDtypeStruct((b, t_kv, hd), v.dtype),
+        ],
+        interpret=interpret,
+    )(k, v, q, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_hd(q, k, v, causal, block_q, block_k, interpret, kv_len, d, hp):
+    with jax.enable_x64(False):
+        out, _ = _flash_hd_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len, d, hp)
+    return out
+
+
+def _flash_hd_vjp_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len, d, hp):
+    with jax.enable_x64(False):
+        out, lse = _flash_hd_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len, d, hp)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_hd_vjp_bwd(causal, block_q, block_k, interpret, kv_len, d, hp, res, do):
+    q, k, v, out, lse = res
+    with jax.enable_x64(False):
+        return _flash_hd_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret, kv_len, d, hp)
+
+
+_flash_hd.defvjp(_flash_hd_vjp_fwd, _flash_hd_vjp_bwd)
+
+
+def _hd_heads_per_program(h: int, d: int):
+    """Heads per program so the lane width hp·D tiles 128 lanes; None if the
+    native-layout path can't tile this head shape."""
+    if d % 128 == 0:
+        return 1
+    if 128 % d == 0 and h % (128 // d) == 0:
+        return 128 // d
+    return None
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, block_q, block_k, interpret, kv_len):
     out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
@@ -477,6 +952,53 @@ def _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[:, None, :]  # (BH, 1, T)
 
     if _resident_ok(max(t, t_kv), d, q.dtype):
+        # Both bwd kernels stream 4 (T,D)-class operands + 2 lse rows and
+        # carry several live (BQ,BK) f32 temporaries, so they get a tighter
+        # row cap than the fwd: rows=8 measured 20 KB over the 16 MB
+        # scoped-vmem limit at T=1024/D=64; rows=4 fits.
+        rows = 1
+        if _MULTI_ROW:
+            rows = _pick_rows(bh, max(t, t_kv), d, q.dtype, arrays=2)
+            while rows > 4:  # bwd hard cap: 8 rows = 16.02M scoped vmem (OOM)
+                rows //= 2
+        if _MULTI_ROW and rows > 1 and t == t_kv:
+            dq = pl.pallas_call(
+                functools.partial(_dq_kernel_multi, block_k=block_k, causal=causal, scale=scale, t_kv=t_kv, kv_len=kv_len, rows=rows),
+                grid=(bh // rows, n_q),
+                in_specs=[
+                    pl.BlockSpec((rows, block_q, d), lambda b, i: (b, i, 0)),
+                    pl.BlockSpec((rows, t_kv, d), lambda b, i: (b, 0, 0)),
+                    pl.BlockSpec((rows, t_kv, d), lambda b, i: (b, 0, 0)),
+                    pl.BlockSpec((rows, block_q, d), lambda b, i: (b, i, 0)),
+                    pl.BlockSpec((rows, 1, block_q), lambda b, i: (b, 0, i)),
+                    pl.BlockSpec((rows, 1, block_q), lambda b, i: (b, 0, i)),
+                ],
+                out_specs=pl.BlockSpec((rows, block_q, d), lambda b, i: (b, i, 0)),
+                out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                interpret=interpret,
+            )(q, k, v, do, lse, delta)
+            dk, dv = pl.pallas_call(
+                functools.partial(_dkv_kernel_multi, block_q=block_q, causal=causal, scale=scale, t_q=t, kv_len=kv_len, rows=rows),
+                grid=(bh // rows, n_kv),
+                in_specs=[
+                    pl.BlockSpec((rows, block_k, d), lambda b, j: (b, j, 0)),
+                    pl.BlockSpec((rows, block_k, d), lambda b, j: (b, j, 0)),
+                    pl.BlockSpec((rows, t, d), lambda b, j: (b, 0, 0)),
+                    pl.BlockSpec((rows, t, d), lambda b, j: (b, 0, 0)),
+                    pl.BlockSpec((rows, 1, t), lambda b, j: (b, 0, 0)),
+                    pl.BlockSpec((rows, 1, t), lambda b, j: (b, 0, 0)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((rows, block_k, d), lambda b, j: (b, j, 0)),
+                    pl.BlockSpec((rows, block_k, d), lambda b, j: (b, j, 0)),
+                ],
+                out_shape=[
+                    jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
+                    jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype),
+                ],
+                interpret=interpret,
+            )(k, v, q, do, lse, delta)
+            return dq, dk, dv
         dq = pl.pallas_call(
             functools.partial(_dq_kernel_resident, block_k=block_k, causal=causal, scale=scale, t_kv=t_kv, kv_len=kv_len),
             grid=(bh, n_q),
@@ -604,6 +1126,23 @@ def flash_attention_array(q, k, v, causal=False, block_q=512, block_k=512, inter
     t_kv = k.shape[1]
     block_q = _pick_block(min(block_q, t), t)
     block_k = _pick_block(min(block_k, t_kv), t_kv)
+
+    # native-layout path: no (B,T,H,D)→(BH,T,D) transpose round-trips (real
+    # per-layer HBM passes in a model); scores scratch caps VMEM
+    hp = _hd_heads_per_program(h, d)
+    if (
+        hp is not None
+        and t == t_kv  # dkv holds full-length-t q/do resident: square only
+        and t % block_q == 0 and t_kv % block_k == 0
+        and _resident_ok(t_kv, hp * d, k.dtype)
+        and block_q * t_kv * 4 <= 4 * 1024 * 1024
+    ):
+        out = _flash_hd(
+            q.reshape(b, t, h * d), k.reshape(b, t_kv, h * d),
+            v.reshape(b, t_kv, h * d), causal, block_q, block_k, interpret,
+            t_kv, d, hp,
+        )
+        return out.reshape(b, t, h, d)
 
     def to_bh(x):
         return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
