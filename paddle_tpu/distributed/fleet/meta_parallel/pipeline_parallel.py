@@ -7,11 +7,15 @@ SectionWorker (``framework/section_worker.cc:153``).
 
 TPU-native: **collective-permute pipelining**. All stages run the SAME SPMD
 program inside one shard_map over the 'pp' mesh axis; activations move to the
-next stage with ``lax.ppermute`` each tick. The schedule loop is traced, so
-XLA overlaps the permute with compute (the role of the reference's separate
-comm streams), and reverse-mode AD through the loop yields the backward
-pipeline automatically — interleaved like 1F1B, with jax.checkpoint
-rematerialization standing in for activation stashing policy.
+next stage with ``lax.ppermute`` each tick. Two schedules:
+
+* ``1F1B`` (default, reference default): EXPLICIT interleaved
+  forward/backward sub-ticks with hand-rolled per-stage ``jax.vjp`` and a
+  circular activation stash of depth 2·n_stages — live activations are
+  O(n_stages), independent of n_micro (``_build_1f1b``).
+* ``F-then-B`` (GPipe): reverse-mode AD through the forward scan with
+  ``jax.checkpoint`` per stage — simpler, but the AD residual stack grows
+  O(n_micro) (``_build``).
 
 Requires uniform stages: each stage applies the same layer structure with its
 own weights (stacked leading 'pp' dim) — the standard TPU formulation. GPT
@@ -82,26 +86,35 @@ def spmd_pipeline_fn(stage_fn: Callable, n_stages: int, n_micro: int, axis: str 
 class PipelineTrainStep:
     """Compiled pipelined train step over non-uniform stages.
 
-    The whole GPipe timeline (n_micro + n_stages - 1 ticks) is ONE traced
-    ``lax.scan`` inside a ``shard_map`` over the 'pp' mesh axis; at each tick
-    every stage runs its OWN segment via ``lax.switch(stage_id, ...)`` —
-    embedding on stage 0, loss head on the last stage (the reference's
-    first/last-stage special cases, pipeline_parallel.py:152 `_forward_step` /
-    `pp_layers.py` loss_fn) — and hands its activation downstream with
-    ``lax.ppermute``. Reverse-mode AD through the scan reverses the permutes,
-    yielding the backward pipeline; ``jax.checkpoint`` around each stage call
-    bounds activation memory the way 1F1B's eager stashing discipline does.
-    Per-microbatch losses are mask-accumulated on the last stage and psum'd so
-    the mean loss is replicated (reference train_batch loss reduce
-    pipeline_parallel.py:220).
+    The schedule is ONE traced ``lax.scan`` inside a ``shard_map`` over the
+    'pp' mesh axis; at each tick every stage runs its OWN segment via
+    ``lax.switch(stage_id, ...)`` — embedding on stage 0, loss head on the
+    last stage (the reference's first/last-stage special cases,
+    pipeline_parallel.py:152 `_forward_step` / `pp_layers.py` loss_fn) — and
+    hands its activation downstream with ``lax.ppermute``. Per-microbatch
+    losses are mask-accumulated on the last stage and psum'd so the mean loss
+    is replicated (reference train_batch loss reduce pipeline_parallel.py:220).
+
+    ``schedule="1F1B"`` (default) runs the explicit interleaved schedule of
+    ``_build_1f1b`` — hand-rolled per-stage backward, O(n_stages) activation
+    stash. ``schedule="F-then-B"`` runs the GPipe formulation of ``_build`` —
+    reverse-mode AD through the forward scan (residual stack O(n_micro),
+    bounded per-tick by ``jax.checkpoint``). Both share the stage-body
+    protocol of ``_stage_caller``.
     """
 
-    def __init__(self, pipeline_layer, optimizer, mesh, n_micro, axis="pp"):
+    def __init__(self, pipeline_layer, optimizer, mesh, n_micro, axis="pp",
+                 schedule="1F1B"):
         self.pl = pipeline_layer
         self.optimizer = optimizer
         self.mesh = mesh
         self.n_micro = int(n_micro)
         self.axis = axis
+        self.schedule = str(schedule).upper().replace("-", "")
+        if self.schedule not in ("1F1B", "FTHENB"):
+            raise ValueError(
+                f"schedule_mode must be '1F1B' or 'F-then-B', got {schedule!r}"
+            )
         self.n_stages = pipeline_layer.num_stages
         pp_devices = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
         if self.n_stages != pp_devices:
@@ -151,65 +164,62 @@ class PipelineTrainStep:
                 )
         return s.shape, s.dtype
 
-    # -- compiled step -----------------------------------------------------
-    def _build(self):
+    # -- stage body shared by both schedules -------------------------------
+    def _stage_caller(self, carrier_dtype):
+        """Build ``call(p_arrs, s, x, ids_t, lbl_t, k) -> (carrier, loss)``:
+        the ONE stage-body protocol both schedules use — param ``_data``
+        swap under try/finally, per-(microbatch, stage) PRNG binding,
+        no_grad (jax traces through; the paddle tape stays off), stage-0
+        embedding ingest and last-stage loss special cases."""
         from ....core import random as random_state
         from ....core.engine import no_grad
 
+        params, buffers = self.params, self.buffers
+        n_stages = self.n_stages
+        loss_fn = getattr(self.pl, "_loss_fn", None)
+
+        def call(p_arrs, s, x, ids_t, lbl_t, k):
+            saved = [(t, t._data) for t in params + buffers]
+            try:
+                for t, a in zip(params, p_arrs):
+                    t._data = a
+                with random_state.traced_keys(k):
+                    with no_grad():
+                        if s == 0:
+                            h = self._run_stage(0, Tensor(ids_t, stop_gradient=True))
+                            return h._data.astype(carrier_dtype), jnp.float32(0.0)
+                        out = self._run_stage(s, Tensor(x))
+                        if s == n_stages - 1:
+                            if loss_fn is not None:
+                                l = loss_fn(out, Tensor(lbl_t, stop_gradient=True))
+                            else:
+                                l = out.mean()
+                            l = l._data if isinstance(l, Tensor) else l
+                            return x, l.astype(jnp.float32)
+                        return out._data.astype(carrier_dtype), jnp.float32(0.0)
+            finally:
+                for t, a in saved:
+                    t._data = a
+
+        return call
+
+    # -- compiled step (F-then-B / GPipe schedule) --------------------------
+    def _build(self):
         n_stages, n_micro, axis = self.n_stages, self.n_micro, self.axis
-        params, buffers, pl = self.params, self.buffers, self.pl
-        loss_fn = getattr(pl, "_loss_fn", None)
+        params = self.params
         carrier_shape, carrier_dtype = self._carrier
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        call_stage = self._stage_caller(carrier_dtype)
 
         def step_fn(param_arrays, opt_state, ids_mb, labels_mb, lr, key):
             def loss_of(p_arrays):
                 def spmd(p_arrays, ids_mb, labels_mb):
-                    saved = [(t, t._data) for t in params + buffers]
-
-                    def bound(fn):
-                        # last positional arg is a per-(tick, stage) PRNG key
-                        # so dropout masks differ across microbatches/stages
-                        def wrapped(*args):
-                            *rest, k = args
-                            try:
-                                for t, a in zip(params, p_arrays):
-                                    t._data = a
-                                with random_state.traced_keys(k):
-                                    with no_grad():
-                                        return fn(*rest)
-                            finally:
-                                for t, a in saved:
-                                    t._data = a
-                        return wrapped
-
-                    @bound
-                    def first_stage(x, ids_t, lbl_t):
-                        h = self._run_stage(0, Tensor(ids_t, stop_gradient=True))
-                        return h._data.astype(carrier_dtype), jnp.float32(0.0)
-
-                    def mid_stage(s):
-                        @bound
-                        def run(x, ids_t, lbl_t):
-                            h = self._run_stage(s, Tensor(x))
-                            return h._data.astype(carrier_dtype), jnp.float32(0.0)
+                    def branch(s):
+                        def run(x, ids_t, lbl_t, k):
+                            return call_stage(p_arrays, s, x, ids_t, lbl_t, k)
                         return run
 
-                    @bound
-                    def last_stage(x, ids_t, lbl_t):
-                        out = self._run_stage(n_stages - 1, Tensor(x))
-                        if loss_fn is not None:
-                            l = loss_fn(out, Tensor(lbl_t, stop_gradient=True))
-                        else:
-                            l = out.mean()
-                        l = l._data if isinstance(l, Tensor) else l
-                        return x, l.astype(jnp.float32)
-
-                    branches = (
-                        [first_stage]
-                        + [mid_stage(s) for s in range(1, n_stages - 1)]
-                        + [last_stage]
-                    )
+                    branches = [branch(s) for s in range(n_stages)]
                     stage_id = lax.axis_index(axis)
 
                     def tick(carry, t):
@@ -259,6 +269,149 @@ class PipelineTrainStep:
 
         return jax.jit(step_fn, donate_argnums=(0, 1))
 
+    # -- 1F1B schedule -----------------------------------------------------
+    def _build_1f1b(self):
+        """Memory-bounded 1F1B (reference ``forward_backward_pipeline``
+        pipeline_parallel.py:80, ``section_worker.cc:153`` Run1F1B).
+
+        The F-then-B builder above lets reverse-mode AD differentiate the
+        GPipe scan — structurally all forwards run before any backward, so
+        the residual stack holds O(n_micro) microbatch activations no matter
+        the checkpoint policy. Here the schedule is EXPLICIT: one scan over
+        ``n_micro + 2(n_stages-1)`` pairs, each pair doing one forward
+        sub-tick (activation ppermutes downstream) and one backward sub-tick
+        (hand-rolled per-stage ``jax.vjp``; cotangent ppermutes upstream).
+        A stage's backward for microbatch j runs ``2(n_stages-1-s)`` pairs
+        after its forward — the 1F1B drain discipline — so the explicit
+        activation stash is a circular buffer of depth 2·n_stages:
+        **live activations are O(n_stages), independent of n_micro**
+        (verified by compiled-HLO peak-temp comparison in
+        tests/test_pp_1f1b.py). Param grads accumulate in f32 in the scan
+        carry; backward recomputes the stage forward from the stashed input
+        (same remat policy as the reference's stash-and-recompute mode).
+        """
+        n_stages, n_micro, axis = self.n_stages, self.n_micro, self.axis
+        params = self.params
+        carrier_shape, carrier_dtype = self._carrier
+        down = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        up = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+        S = 2 * n_stages  # stash depth ≥ max in-flight 2(n_stages-1)+1
+        call_stage = self._stage_caller(carrier_dtype)
+
+        def step_fn(param_arrays, opt_state, ids_mb, labels_mb, lr, key):
+            def spmd(p_arrays, ids_mb, labels_mb):
+                stage_id = lax.axis_index(axis)
+
+                def f_branch(s):
+                    def run(p_arrs, x, ids_t, lbl_t, k):
+                        return call_stage(p_arrs, s, x, ids_t, lbl_t, k)
+                    return run
+
+                def b_branch(s):
+                    def run(p_arrs, x, ids_t, lbl_t, g_in, k):
+                        if s == n_stages - 1:
+                            def f(p, xx):
+                                _, l = call_stage(p, s, xx, ids_t, lbl_t, k)
+                                return l
+                            _, vjp = jax.vjp(f, p_arrs, x)
+                            dp, dx = vjp(jnp.float32(1.0 / n_micro))
+                            return dx.astype(carrier_dtype), dp
+                        if s == 0:
+                            def f(p):
+                                y, _ = call_stage(p, 0, x, ids_t, lbl_t, k)
+                                return y
+                            _, vjp = jax.vjp(f, p_arrs)
+                            (dp,) = vjp(g_in)
+                            return jnp.zeros(carrier_shape, carrier_dtype), dp
+                        def f(p, xx):
+                            y, _ = call_stage(p, s, xx, ids_t, lbl_t, k)
+                            return y
+                        _, vjp = jax.vjp(f, p_arrs, x)
+                        dp, dx = vjp(g_in)
+                        return dx.astype(carrier_dtype), dp
+                    return run
+
+                f_branches = [f_branch(s) for s in range(n_stages)]
+                b_branches = [b_branch(s) for s in range(n_stages)]
+                is_last = stage_id == n_stages - 1
+
+                def pair(carry, u):
+                    act, g_up, stash, loss_acc, gaccs = carry
+                    # ---- forward sub-tick: stage s runs microbatch u - s
+                    jf = u - stage_id
+                    f_valid = (jf >= 0) & (jf < n_micro)
+                    jf_c = jnp.clip(jf, 0, n_micro - 1)
+                    ids_f = lax.dynamic_index_in_dim(ids_mb, jf_c, keepdims=False)
+                    lbl_f = lax.dynamic_index_in_dim(labels_mb, jf_c, keepdims=False)
+                    k_f = jax.random.fold_in(jax.random.fold_in(key, jf_c), stage_id)
+                    y, l = lax.switch(stage_id, f_branches, p_arrays, act, ids_f, lbl_f, k_f)
+                    loss_acc = loss_acc + jnp.where(f_valid & is_last, l, 0.0)
+                    # stash this stage's INPUT for the backward recompute
+                    stash = lax.cond(
+                        f_valid,
+                        lambda st: lax.dynamic_update_index_in_dim(
+                            st, act, jf_c % S, axis=0),
+                        lambda st: st,
+                        stash,
+                    )
+                    act_next = lax.ppermute(y, axis, down)
+                    # ---- backward sub-tick: stage s drains microbatch
+                    # u - (2(n_stages-1) - s); keys re-derive from (j, s) so
+                    # the recompute reuses the forward's dropout masks
+                    jb = u - (2 * (n_stages - 1) - stage_id)
+                    b_valid = (jb >= 0) & (jb < n_micro)
+                    jb_c = jnp.clip(jb, 0, n_micro - 1)
+                    x_b = lax.dynamic_index_in_dim(stash, jb_c % S, keepdims=False)
+                    ids_b = lax.dynamic_index_in_dim(ids_mb, jb_c, keepdims=False)
+                    lbl_b = lax.dynamic_index_in_dim(labels_mb, jb_c, keepdims=False)
+                    k_b = jax.random.fold_in(jax.random.fold_in(key, jb_c), stage_id)
+                    dx, dps = lax.switch(
+                        stage_id, b_branches, p_arrays, x_b, ids_b, lbl_b, g_up, k_b)
+                    bsel = jnp.where(b_valid, jnp.float32(1.0), jnp.float32(0.0))
+                    gaccs = tuple(
+                        ga + bsel * dp.astype(jnp.float32)
+                        for ga, dp in zip(gaccs, dps)
+                    )
+                    g_next = lax.ppermute(
+                        jnp.where(b_valid, dx, jnp.zeros_like(dx)), axis, up)
+                    return (act_next, g_next, stash, loss_acc, gaccs), None
+
+                act0 = jnp.zeros(carrier_shape, carrier_dtype)
+                g0 = jnp.zeros(carrier_shape, carrier_dtype)
+                stash0 = jnp.zeros((S,) + tuple(carrier_shape), carrier_dtype)
+                gaccs0 = tuple(jnp.zeros(a.shape, jnp.float32) for a in p_arrays)
+                total = n_micro + 2 * (n_stages - 1)
+                (_, _, _, loss_acc, gaccs), _ = lax.scan(
+                    pair, (act0, g0, stash0, jnp.float32(0.0), gaccs0),
+                    jnp.arange(total),
+                )
+                loss = lax.psum(loss_acc, axis) / n_micro
+                grads = tuple(
+                    lax.psum(g, axis).astype(a.dtype)
+                    for g, a in zip(gaccs, p_arrays)
+                )
+                return loss, grads
+
+            from jax.sharding import PartitionSpec as P
+
+            from ...mesh import shard_map_compat
+
+            _shard_map, _check = shard_map_compat()
+            fn = _shard_map(
+                spmd,
+                mesh=self.mesh,
+                in_specs=(tuple(P() for _ in param_arrays), P(), P()),
+                out_specs=(P(), tuple(P() for _ in param_arrays)),
+                **_check,
+            )
+            loss, grads = fn(tuple(param_arrays), ids_mb, labels_mb)
+            new_params, new_state = self.optimizer._functional_update(
+                param_arrays, list(grads), opt_state, lr, params=params
+            )
+            return loss, new_params, new_state
+
+        return jax.jit(step_fn, donate_argnums=(0, 1))
+
     def __call__(self, inputs, labels):
         from ....core import random as random_state
         from ....core.engine import no_grad
@@ -280,7 +433,8 @@ class PipelineTrainStep:
         step = self._jits.get(shape_key)
         if step is None:
             self._carrier = self._probe_carrier(ids_mb[0])
-            step = self._jits[shape_key] = self._build()
+            build = self._build_1f1b if self.schedule == "1F1B" else self._build
+            step = self._jits[shape_key] = build()
 
         with no_grad():
             param_arrays = [p._data for p in self.params]
@@ -353,6 +507,8 @@ class PipelineParallelModel(Layer):
             if self._train_fn is None:
                 self._train_fn = PipelineTrainStep(
                     self._layers, optimizer, self._hcg.mesh, n_micro=acc, axis="pp",
+                    schedule=self._strategy.pipeline_configs.get(
+                        "schedule_mode", "1F1B"),
                 )
             loss = self._train_fn(inputs, labels)
         else:

@@ -943,6 +943,87 @@ def _q_index_map(lane: bool = False):
     return imap
 
 
+# A/B: MERGED backward LOSES — kept behind _MERGED_BWD for reproducibility.
+# Measured at (B=2,H=16,T=8192,D=64) bf16, 24-layer chain, v5e:
+#   two-kernel bwd (dq + dkv): fwd+bwd 12.64 ms/layer
+#   merged single-sweep bwd:   fwd+bwd 16.94 ms/layer   <- LOSES 34%
+# The saved score/dp recompute (2 of 7 matmuls) is outweighed by the
+# per-iteration read-modify-write of the persistent (T, D) f32 dq scratch.
+_MERGED_BWD = False
+
+
+def _dfused_kernel_resident(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dq_ref, dq_sc, *, block_q: int, causal: bool, scale: float, t_q: int, kv_len: int, n_kv: int):
+    # MERGED backward: one sweep computes dq, dk, dv — the separate dq pass's
+    # score and dp recomputes (2 of the 7 backward matmuls, plus one of the
+    # two exp passes) disappear. Grid (BH, n_kv): dk/dv are per-block
+    # outputs; dq accumulates in a PERSISTENT f32 VMEM scratch across the
+    # consecutive ik steps of one row and is written once at ik == n_kv-1
+    # (the dq output block is the full (1, T, D) row, revisited across ik).
+    # k/v/dk/dv: (1, BK, D); q/do: (1, T, D); lse/delta: (1, 1, T);
+    # dq: (1, T, D); dq_sc: (T, D) f32.
+    ik = pl.program_id(1)
+    bk = k_ref.shape[1]
+    d = k_ref.shape[2]
+    k_blk = k_ref[0]  # (BK, D)
+    _PREC = _prec(k_blk.dtype)
+    v_blk = v_ref[0]
+    n_qb = t_q // block_q
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    def body(qb, carry):
+        dk, dv = carry
+        qq = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        s = jax.lax.dot_general(
+            qq, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        ) * jnp.float32(scale)  # (BQ, BK)
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+        valid = k_pos < kv_len
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, jnp.float32(_NEG_INF))
+        p = jnp.exp(s - lse[:, None])  # (BQ, BK)
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )  # (BK, D)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=_PREC
+        )  # (BQ, BK)
+        ds = p * (dp - delta[:, None])  # unscaled; scale folded at the writes
+        dsb = ds.astype(qq.dtype)
+        dk = dk + jax.lax.dot_general(
+            dsb, qq, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )  # (BK, D)
+        dq_sc[pl.ds(qb * block_q, block_q), :] = (
+            dq_sc[pl.ds(qb * block_q, block_q), :]
+            + jax.lax.dot_general(
+                dsb, k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )
+        )
+        return dk, dv
+
+    first_qb = ik if (causal and bk == block_q) else 0
+    dk, dv = jax.lax.fori_loop(
+        first_qb, n_qb, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
+    )
+    dk_ref[0] = (dk * jnp.float32(scale)).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(ik == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = (dq_sc[:] * jnp.float32(scale)).astype(dq_ref.dtype)
+
+
 def _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret, kv_len):
     bh, t, d = q.shape
     t_kv = k.shape[1]
@@ -952,6 +1033,39 @@ def _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[:, None, :]  # (BH, 1, T)
 
     if _resident_ok(max(t, t_kv), d, q.dtype):
+        # merged single-sweep backward: needs q/do resident + a (T, D) f32
+        # dq accumulator scratch + k/v blocks; square self-attention only
+        # (causal block skip + the dq row write assume t == t_kv)
+        if (_MERGED_BWD and t == t_kv and block_q == block_k
+                and t * d * 4 <= 4 * 1024 * 1024):
+            dk, dv, dq = pl.pallas_call(
+                functools.partial(
+                    _dfused_kernel_resident, block_q=block_q, causal=causal,
+                    scale=scale, t_q=t, kv_len=kv_len, n_kv=n_kv,
+                ),
+                grid=(bh, n_kv),
+                in_specs=[
+                    pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                    pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                    pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
+                    pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
+                    pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
+                    pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                    pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                    pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
+                ],
+                out_shape=[
+                    jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
+                    jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype),
+                    jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                ],
+                scratch_shapes=[pltpu.VMEM((t, d), jnp.float32)],
+                interpret=interpret,
+            )(k, v, q, do, lse, delta)
+            return dq, dk, dv
         # Both bwd kernels stream 4 (T,D)-class operands + 2 lse rows and
         # carry several live (BQ,BK) f32 temporaries, so they get a tighter
         # row cap than the fwd: rows=8 measured 20 KB over the 16 MB
