@@ -26,7 +26,8 @@ _SPEC_PATH = os.path.join(os.path.dirname(__file__), "ops.yaml")
 _ENV = {"jax": jax, "jnp": jnp, "lax": lax, "np": np, "__builtins__": {
     "len": len, "range": range, "tuple": tuple, "list": list, "sum": sum,
     "int": int, "float": float, "bool": bool, "min": min, "max": max,
-    "hasattr": hasattr, "isinstance": isinstance,
+    "hasattr": hasattr, "isinstance": isinstance, "zip": zip,
+    "enumerate": enumerate,
 }}
 
 

@@ -1,0 +1,150 @@
+"""One-shot metric ops (reference ``operators/metrics/`` + ``edit_distance_op``
++ ``mean_iou_op`` + ``positive_negative_pair_op``).
+
+The reference's metric ops are stateful accumulators driven by the trainer
+loop; the streaming role here is filled by ``paddle_tpu.metric`` classes.
+These are the OP-surface equivalents: pure functions over a batch, jit-safe
+(static shapes, lax loops), usable inside compiled evaluation steps.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import as_tensor, eager_call
+
+__all__ = ["auc", "edit_distance", "mean_iou", "precision_recall",
+           "positive_negative_pair"]
+
+
+def auc(pred, label, name=None):
+    """ROC-AUC of binary scores via the rank statistic (metrics/auc_op.cc
+    computes the same integral from threshold buckets).
+    pred: (N,) scores; label: (N,) {0,1}. Empty class -> 0.5."""
+    pred, label = as_tensor(pred), as_tensor(label)
+
+    def fn(p, y):
+        p = p.reshape(-1).astype(jnp.float32)
+        y = y.reshape(-1)
+        # average ranks under ties (a tied pos/neg pair counts 0.5, like the
+        # reference's bucketed integral): r_i = (#{p<p_i} + #{p<=p_i} + 1)/2
+        less = (p[None, :] < p[:, None]).sum(axis=1).astype(jnp.float32)
+        leq = (p[None, :] <= p[:, None]).sum(axis=1).astype(jnp.float32)
+        ranks = (less + leq + 1.0) / 2.0
+        pos = (y > 0).astype(jnp.float32)
+        npos = pos.sum()
+        nneg = p.size - npos
+        s = (ranks * pos).sum() - npos * (npos + 1) / 2.0
+        return jnp.where(npos * nneg > 0, s / jnp.maximum(npos * nneg, 1.0), 0.5)
+
+    return eager_call("metric_auc", fn, [pred, label], differentiable=False)
+
+
+def edit_distance(hyp, hyp_length, ref, ref_length, normalized=True, name=None):
+    """Batched Levenshtein distance over padded id sequences
+    (edit_distance_op.cc). hyp: (B, Th), ref: (B, Tr) + lengths."""
+    hyp, hyp_length = as_tensor(hyp), as_tensor(hyp_length)
+    ref, ref_length = as_tensor(ref), as_tensor(ref_length)
+
+    def fn(h, hl, r, rl, normalized):
+        th, tr = h.shape[1], r.shape[1]
+
+        def one(hrow, hn, rrow, rn):
+            row0 = jnp.arange(tr + 1, dtype=jnp.float32)
+
+            def step(i, row):
+                # DP row i+1: d[i+1, j]
+                def col(j, acc):
+                    row_new, diag = acc
+                    cost = jnp.where(
+                        (hrow[i] == rrow[j]) | (j >= rn), 0.0, 1.0)
+                    ins = row_new[j] + jnp.where(j < rn, 1.0, 0.0)
+                    dele = row[j + 1] + 1.0
+                    sub = diag + cost
+                    v = jnp.where(j < rn, jnp.minimum(jnp.minimum(ins, dele), sub),
+                                  row_new[j])
+                    return row_new.at[j + 1].set(v), row[j + 1]
+
+                init = row.at[0].set(row[0] + 1.0)
+                row_new, _ = lax.fori_loop(0, tr, col, (init, row[0]))
+                return jnp.where(i < hn, row_new, row)
+
+            row = lax.fori_loop(0, th, step, row0)
+            d = row[jnp.clip(rn, 0, tr)]
+            return jnp.where(normalized, d / jnp.maximum(rn, 1).astype(jnp.float32), d)
+
+        return jax.vmap(one)(h, hl, r, rl)
+
+    return eager_call("edit_distance", fn, [hyp, hyp_length, ref, ref_length],
+                      {"normalized": bool(normalized)}, differentiable=False)
+
+
+def mean_iou(pred, label, num_classes, name=None):
+    """Mean intersection-over-union across classes (mean_iou_op.cc).
+    pred/label: int class maps of equal shape."""
+    pred, label = as_tensor(pred), as_tensor(label)
+
+    def fn(p, y, num_classes):
+        p = p.reshape(-1)
+        y = y.reshape(-1)
+        oh_p = jax.nn.one_hot(p, num_classes, dtype=jnp.float32)
+        oh_y = jax.nn.one_hot(y, num_classes, dtype=jnp.float32)
+        inter = (oh_p * oh_y).sum(0)
+        union = oh_p.sum(0) + oh_y.sum(0) - inter
+        present = union > 0
+        iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+        return iou.sum() / jnp.maximum(present.sum(), 1)
+
+    return eager_call("mean_iou", fn, [pred, label],
+                      {"num_classes": int(num_classes)}, differentiable=False)
+
+
+def precision_recall(pred, label, num_classes, name=None):
+    """Per-batch macro precision/recall/F1 (metrics/precision_recall_op.cc).
+    pred: (N,) predicted classes; label: (N,). Returns (precision, recall, f1)."""
+    pred, label = as_tensor(pred), as_tensor(label)
+
+    def fn(p, y, num_classes):
+        p = p.reshape(-1)
+        y = y.reshape(-1)
+        oh_p = jax.nn.one_hot(p, num_classes, dtype=jnp.float32)
+        oh_y = jax.nn.one_hot(y, num_classes, dtype=jnp.float32)
+        tp = (oh_p * oh_y).sum(0)
+        fp = oh_p.sum(0) - tp
+        fn_ = oh_y.sum(0) - tp
+        present = oh_y.sum(0) > 0
+        prec = jnp.where(present, tp / jnp.maximum(tp + fp, 1.0), 0.0)
+        rec = jnp.where(present, tp / jnp.maximum(tp + fn_, 1.0), 0.0)
+        npres = jnp.maximum(present.sum(), 1)
+        mp, mr = prec.sum() / npres, rec.sum() / npres
+        f1 = jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr, 1e-12), 0.0)
+        return mp, mr, f1
+
+    return eager_call("precision_recall", fn, [pred, label],
+                      {"num_classes": int(num_classes)}, differentiable=False)
+
+
+def positive_negative_pair(score, label, query_id, name=None):
+    """Count correctly/incorrectly ordered pairs within each query group
+    (positive_negative_pair_op.cc). score/label/query_id: (N,).
+    Returns (positive_pairs, negative_pairs, neutral_pairs)."""
+    score, label = as_tensor(score), as_tensor(label)
+    query_id = as_tensor(query_id)
+
+    def fn(s, y, q):
+        s = s.reshape(-1).astype(jnp.float32)
+        y = y.reshape(-1).astype(jnp.float32)
+        q = q.reshape(-1)
+        same_q = q[:, None] == q[None, :]
+        upper = jnp.triu(jnp.ones((s.size, s.size), bool), 1)
+        pair = same_q & upper & (y[:, None] != y[None, :])
+        better = (y[:, None] > y[None, :])
+        s_diff = s[:, None] - s[None, :]
+        pos = (pair & (jnp.sign(s_diff) == jnp.sign(jnp.where(better, 1.0, -1.0)))).sum()
+        neu = (pair & (s_diff == 0)).sum()
+        neg = pair.sum() - pos - neu
+        return pos, neg, neu
+
+    return eager_call("positive_negative_pair", fn, [score, label, query_id],
+                      differentiable=False)

@@ -68,6 +68,16 @@ def all_ops() -> Dict[str, Callable]:
         ops.update({f"text.{n}": getattr(text_mod, n) for n in ("viterbi_decode",)})
     except ImportError:
         pass
+    from . import sequence as sequence_mod
+
+    ops.update({
+        f"sequence.{n}": getattr(sequence_mod, n) for n in sequence_mod.__all__
+    })
+    from . import metrics_ops
+
+    ops.update({
+        f"metric.{n}": getattr(metrics_ops, n) for n in metrics_ops.__all__
+    })
     ops.update(inplace.INPLACE_OPS)
     return ops
 

@@ -1,0 +1,330 @@
+"""Sequence op family — the reference's LoD ``sequence_ops/`` re-designed
+masked-ragged.
+
+Reference: ``paddle/fluid/operators/sequence_ops/`` (16 ops over LoDTensors —
+variable-length rows packed flat with a level-of-detail offset table). LoD is
+a CPU-pointer idiom; the TPU-native representation is PADDED + LENGTHS:
+``x: (B, T, ...)`` with ``length: (B,)`` valid counts (static shapes, XLA
+tiles cleanly, and it is exactly what `functional.sequence_mask` / the ragged
+BucketSampler already produce). Every op here takes/returns that pair; ops
+that change lengths return ``(values, new_length)``.
+
+All ops route through ``core.dispatch.eager_call`` so they carry autograd,
+AMP hooks, per-op jit caching and nan/inf scans like every other op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import as_tensor, eager_call
+
+__all__ = [
+    "sequence_pad", "sequence_unpad", "sequence_softmax", "sequence_pool",
+    "sequence_reverse", "sequence_expand", "sequence_expand_as",
+    "sequence_concat", "sequence_slice", "sequence_erase",
+    "sequence_enumerate", "sequence_reshape", "sequence_scatter",
+    "sequence_topk_avg_pooling", "sequence_conv", "sequence_first_step",
+    "sequence_last_step",
+]
+
+
+def _valid(length, t):
+    """(B, T) bool validity mask from (B,) lengths."""
+    return jnp.arange(t)[None, :] < length[:, None]
+
+
+def sequence_pad(x, length, max_len, pad_value=0.0, name=None):
+    """Flat packed values -> padded batch (reference sequence_pad_op.cc).
+
+    x: (total, ...) rows of all sequences concatenated; length: (B,);
+    returns (B, max_len, ...) with ``pad_value`` beyond each row's length.
+    """
+    x, length = as_tensor(x), as_tensor(length)
+
+    def fn(xv, lv, max_len, pad_value):
+        off = jnp.concatenate([jnp.zeros((1,), lv.dtype), jnp.cumsum(lv)[:-1]])
+        t = jnp.arange(max_len)[None, :]
+        idx = jnp.clip(off[:, None] + t, 0, xv.shape[0] - 1)
+        out = xv[idx]
+        mask = (t < lv[:, None]).reshape(idx.shape + (1,) * (xv.ndim - 1))
+        return jnp.where(mask, out, jnp.asarray(pad_value, out.dtype))
+
+    return eager_call("sequence_pad", fn, [x, length],
+                      {"max_len": int(max_len), "pad_value": float(pad_value)})
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded batch -> flat packed values (reference sequence_unpad_op.cc).
+
+    Returns (B*T, ...): the first sum(length) rows hold the valid values in
+    order, the rest are zeros (static-shape compaction)."""
+    x, length = as_tensor(x), as_tensor(length)
+
+    def fn(xv, lv):
+        b, t = xv.shape[0], xv.shape[1]
+        off = jnp.concatenate([jnp.zeros((1,), lv.dtype), jnp.cumsum(lv)[:-1]])
+        tt = jnp.arange(t)[None, :]
+        valid = tt < lv[:, None]
+        # invalid rows scatter into a trash slot past the end
+        pos = jnp.where(valid, off[:, None] + tt, b * t)
+        flat = xv.reshape((b * t,) + xv.shape[2:])
+        out = jnp.zeros((b * t + 1,) + xv.shape[2:], xv.dtype)
+        out = out.at[pos.reshape(-1)].set(flat)
+        return out[: b * t]
+
+    return eager_call("sequence_unpad", fn, [x, length])
+
+
+def sequence_softmax(x, length, name=None):
+    """Masked softmax over the time axis (reference sequence_softmax_op.cc)."""
+    x, length = as_tensor(x), as_tensor(length)
+
+    def fn(xv, lv):
+        mask = _valid(lv, xv.shape[1])
+        mask = mask.reshape(mask.shape + (1,) * (xv.ndim - 2))
+        s = jnp.where(mask, xv.astype(jnp.float32), -jnp.inf)
+        p = jax.nn.softmax(s, axis=1)
+        return jnp.where(mask, p, 0.0).astype(xv.dtype)
+
+    return eager_call("sequence_softmax", fn, [x, length])
+
+
+def sequence_pool(x, length, pool_type="SUM", name=None):
+    """Masked pooling over time (reference sequence_pool_op.cc):
+    SUM | AVERAGE | SQRT | MAX | MIN | LAST | FIRST."""
+    x, length = as_tensor(x), as_tensor(length)
+    pt = pool_type.upper()
+    if pt not in ("SUM", "AVERAGE", "SQRT", "MAX", "MIN", "LAST", "FIRST"):
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    def fn(xv, lv, pt):
+        t = xv.shape[1]
+        mask = _valid(lv, t).reshape((xv.shape[0], t) + (1,) * (xv.ndim - 2))
+        n = jnp.maximum(lv, 1).reshape((-1,) + (1,) * (xv.ndim - 2))
+        if pt == "SUM":
+            return jnp.where(mask, xv, 0).sum(axis=1)
+        if pt == "AVERAGE":
+            return jnp.where(mask, xv, 0).sum(axis=1) / n.astype(xv.dtype)
+        if pt == "SQRT":
+            return jnp.where(mask, xv, 0).sum(axis=1) / jnp.sqrt(n.astype(xv.dtype))
+        if pt in ("MAX", "MIN"):
+            fill = -jnp.inf if pt == "MAX" else jnp.inf
+            red = jnp.where(mask, xv, fill)
+            out = red.max(axis=1) if pt == "MAX" else red.min(axis=1)
+            # zero-length rows (legal: e.g. sequence_slice can produce them)
+            # must not emit +-inf into downstream reductions
+            empty = (lv == 0).reshape((-1,) + (1,) * (xv.ndim - 2))
+            return jnp.where(empty, jnp.zeros_like(out), out)
+        idx = (lv - 1 if pt == "LAST" else jnp.zeros_like(lv))
+        return jnp.take_along_axis(
+            xv, jnp.clip(idx, 0, t - 1).reshape((-1, 1) + (1,) * (xv.ndim - 2)), axis=1
+        )[:, 0]
+
+    return eager_call("sequence_pool", fn, [x, length], {"pt": pt})
+
+
+def sequence_first_step(x, length, name=None):
+    return sequence_pool(x, length, "FIRST")
+
+
+def sequence_last_step(x, length, name=None):
+    return sequence_pool(x, length, "LAST")
+
+
+def sequence_reverse(x, length, name=None):
+    """Reverse each row's valid prefix (reference sequence_reverse_op.cc)."""
+    x, length = as_tensor(x), as_tensor(length)
+
+    def fn(xv, lv):
+        t = xv.shape[1]
+        tt = jnp.arange(t)[None, :]
+        idx = jnp.where(tt < lv[:, None], lv[:, None] - 1 - tt, tt)
+        return jnp.take_along_axis(
+            xv, idx.reshape(idx.shape + (1,) * (xv.ndim - 2)), axis=1)
+
+    return eager_call("sequence_reverse", fn, [x, length])
+
+
+def sequence_expand(x, length, max_len, name=None):
+    """Broadcast each batch row along a fresh time axis of per-row length
+    (reference sequence_expand_op.cc with ref_level lengths). x: (B, ...) ->
+    (B, max_len, ...) masked to ``length``."""
+    x, length = as_tensor(x), as_tensor(length)
+
+    def fn(xv, lv, max_len):
+        out = jnp.broadcast_to(xv[:, None], (xv.shape[0], max_len) + xv.shape[1:])
+        mask = _valid(lv, max_len).reshape(
+            (xv.shape[0], max_len) + (1,) * (xv.ndim - 1))
+        return jnp.where(mask, out, 0)
+
+    return eager_call("sequence_expand", fn, [x, length], {"max_len": int(max_len)})
+
+
+def sequence_expand_as(x, y, y_length, name=None):
+    """Expand x rows to y's time layout (reference sequence_expand_as_op.cc)."""
+    y = as_tensor(y)
+    return sequence_expand(x, y_length, max_len=y._data.shape[1])
+
+
+def sequence_concat(x, x_length, y, y_length, name=None):
+    """Time-wise ragged concat (reference sequence_concat_op.cc):
+    row b becomes x[b,:lx[b]] ++ y[b,:ly[b]]. Returns (values, new_length)."""
+    x, x_length = as_tensor(x), as_tensor(x_length)
+    y, y_length = as_tensor(y), as_tensor(y_length)
+
+    def fn(xv, lx, yv, ly):
+        t1, t2 = xv.shape[1], yv.shape[1]
+        cat = jnp.concatenate([xv, yv], axis=1)  # (B, T1+T2, ...)
+        tt = jnp.arange(t1 + t2)[None, :]
+        # read x[t] while t < lx, else y[t - lx]
+        idx = jnp.where(tt < lx[:, None], tt, t1 + jnp.clip(tt - lx[:, None], 0, t2 - 1))
+        out = jnp.take_along_axis(
+            cat, idx.reshape(idx.shape + (1,) * (xv.ndim - 2)), axis=1)
+        mask = (tt < (lx + ly)[:, None]).reshape(
+            idx.shape + (1,) * (xv.ndim - 2))
+        return jnp.where(mask, out, 0), lx + ly
+
+    return eager_call("sequence_concat", fn, [x, x_length, y, y_length],
+                      nondiff_outputs=(1,))
+
+
+def sequence_slice(x, length, offset, slice_length, name=None):
+    """Per-row slice [offset, offset+slice_length) (sequence_slice_op.cc).
+    offset/slice_length: (B,). Returns (values, new_length)."""
+    x, length = as_tensor(x), as_tensor(length)
+    offset, slice_length = as_tensor(offset), as_tensor(slice_length)
+
+    def fn(xv, lv, off, sl):
+        t = xv.shape[1]
+        tt = jnp.arange(t)[None, :]
+        idx = jnp.clip(off[:, None] + tt, 0, t - 1)
+        out = jnp.take_along_axis(
+            xv, idx.reshape(idx.shape + (1,) * (xv.ndim - 2)), axis=1)
+        new_len = jnp.minimum(sl, jnp.maximum(lv - off, 0))
+        mask = (tt < new_len[:, None]).reshape(idx.shape + (1,) * (xv.ndim - 2))
+        return jnp.where(mask, out, 0), new_len
+
+    return eager_call("sequence_slice", fn, [x, length, offset, slice_length],
+                      nondiff_outputs=(1,))
+
+
+def sequence_erase(x, length, tokens, name=None):
+    """Remove listed token ids and compact (sequence_erase_op.cc).
+    x: (B, T) int ids. Returns (values, new_length)."""
+    x, length = as_tensor(x), as_tensor(length)
+
+    def fn(xv, lv, tokens):
+        b, t = xv.shape
+        tt = jnp.arange(t)[None, :]
+        keep = (tt < lv[:, None]) & ~jnp.isin(xv, jnp.asarray(list(tokens)))
+        pos = jnp.cumsum(keep, axis=1) - 1  # target slot per kept token
+        pos = jnp.where(keep, pos, t)  # trash slot
+        out = jnp.zeros((b, t + 1), xv.dtype)
+        out = out.at[jnp.arange(b)[:, None], pos].set(xv)
+        return out[:, :t], keep.sum(axis=1).astype(lv.dtype)
+
+    return eager_call("sequence_erase", fn, [x, length],
+                      {"tokens": tuple(int(t) for t in tokens)},
+                      differentiable=False)
+
+
+def sequence_enumerate(x, length, win_size, pad_value=0, name=None):
+    """Sliding windows of ids (sequence_enumerate_op.cc): (B, T) ->
+    (B, T, win_size); positions past the row length give pad_value."""
+    x, length = as_tensor(x), as_tensor(length)
+
+    def fn(xv, lv, win_size, pad_value):
+        t = xv.shape[1]
+        tt = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]  # (T, W)
+        idx = jnp.clip(tt, 0, t - 1)
+        out = xv[:, idx]  # (B, T, W)
+        ok = tt[None, :, :] < lv[:, None, None]
+        return jnp.where(ok, out, jnp.asarray(pad_value, xv.dtype))
+
+    return eager_call("sequence_enumerate", fn, [x, length],
+                      {"win_size": int(win_size), "pad_value": int(pad_value)},
+                      differentiable=False)
+
+
+def sequence_reshape(x, length, new_dim, name=None):
+    """Re-chunk each row's values to width new_dim (sequence_reshape_op.cc).
+    x: (B, T, D) with T*D % new_dim == 0; lengths scale by D/new_dim.
+    Returns (values, new_length)."""
+    x, length = as_tensor(x), as_tensor(length)
+
+    def fn(xv, lv, new_dim):
+        b, t, d = xv.shape
+        out = xv.reshape(b, t * d // new_dim, new_dim)
+        return out, (lv * d) // new_dim
+
+    return eager_call("sequence_reshape", fn, [x, length],
+                      {"new_dim": int(new_dim)}, nondiff_outputs=(1,))
+
+
+def sequence_scatter(x, index, updates, updates_length, name=None):
+    """Scatter-add per-row updates at per-row positions
+    (sequence_scatter_op.cc). x: (B, T); index/updates: (B, K)."""
+    x, index = as_tensor(x), as_tensor(index)
+    updates, updates_length = as_tensor(updates), as_tensor(updates_length)
+
+    def fn(xv, idx, upd, ul):
+        k = idx.shape[1]
+        ok = jnp.arange(k)[None, :] < ul[:, None]
+        upd = jnp.where(ok, upd, 0)
+        b = xv.shape[0]
+        return xv.at[jnp.arange(b)[:, None], jnp.clip(idx, 0, xv.shape[1] - 1)].add(upd)
+
+    return eager_call("sequence_scatter", fn, [x, index, updates, updates_length])
+
+
+def sequence_topk_avg_pooling(x, length, topks, name=None):
+    """Mean of each row's top-k valid values for every k in ``topks``
+    (sequence_topk_avg_pooling_op.cc). x: (B, T) -> (B, len(topks))."""
+    x, length = as_tensor(x), as_tensor(length)
+
+    def fn(xv, lv, topks):
+        t = xv.shape[1]
+        masked = jnp.where(_valid(lv, t), xv.astype(jnp.float32), -jnp.inf)
+        srt = jnp.sort(masked, axis=1)[:, ::-1]  # desc
+        srt = jnp.where(jnp.isfinite(srt), srt, 0.0)
+        csum = jnp.cumsum(srt, axis=1)
+        outs = []
+        for k in topks:
+            kk = jnp.minimum(lv, k)
+            kk = jnp.maximum(kk, 1)
+            outs.append(jnp.take_along_axis(csum, (kk - 1)[:, None], axis=1)[:, 0]
+                        / kk.astype(jnp.float32))
+        return jnp.stack(outs, axis=1).astype(xv.dtype)
+
+    return eager_call("sequence_topk_avg_pooling", fn, [x, length],
+                      {"topks": tuple(int(k) for k in topks)})
+
+
+def sequence_conv(x, length, weight, context_start=None, name=None):
+    """Context-window convolution over time (sequence_conv_op.cc).
+    x: (B, T, D); weight: (ctx*D, M); positions outside the row are zero.
+    context length = weight.shape[0] // D, default centered window."""
+    x, length, weight = as_tensor(x), as_tensor(length), as_tensor(weight)
+
+    def fn(xv, lv, wv, context_start):
+        b, t, d = xv.shape
+        ctx = wv.shape[0] // d
+        start = context_start if context_start is not None else -(ctx // 2)
+        mask = _valid(lv, t)[:, :, None]
+        xz = jnp.where(mask, xv, 0)
+        frames = []
+        for c in range(ctx):
+            shift = start + c
+            rolled = jnp.roll(xz, -shift, axis=1)
+            tt = jnp.arange(t)[None, :] + shift
+            ok = (tt >= 0) & (tt < lv[:, None])
+            frames.append(jnp.where(ok[:, :, None], rolled, 0))
+        stacked = jnp.concatenate(frames, axis=-1)  # (B, T, ctx*D)
+        out = jnp.einsum("btc,cm->btm", stacked, wv)
+        return jnp.where(mask, out, 0)
+
+    return eager_call(
+        "sequence_conv", fn, [x, length, weight],
+        {"context_start": None if context_start is None else int(context_start)},
+    )

@@ -20,3 +20,4 @@ from .shufflenetv2 import (  # noqa: F401
 )
 from .resnext import ResNeXt, resnext50_32x4d, resnext101_32x4d, resnext152_32x4d  # noqa: F401
 from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
+from .yolov3 import YOLOv3, yolov3_darknet53, YOLOv3Postprocess  # noqa: F401

@@ -408,3 +408,406 @@ def _make_deform_conv_layer():
 
 
 DeformConv2D = _make_deform_conv_layer()
+
+
+def _pairwise_iou(a, b, off=0.0):
+    """(N, 4) x (M, 4) xyxy -> (N, M) IoU; off=1.0 for unnormalized boxes."""
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    x1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(x2 - x1 + off, 0) * jnp.maximum(y2 - y1 + off, 0)
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+
+# -- Round-5 detection op family ---------------------------------------------
+# Reference: paddle/fluid/operators/detection/*.cc. All STATIC-SHAPE and
+# jit-safe: "suppression" ops use score decay (matrix NMS) or masked top-k
+# instead of dynamic output counts, so they compile into AOT serving graphs.
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU matrix (detection/iou_similarity_op.cc).
+    x: (N, 4), y: (M, 4) xyxy -> (N, M)."""
+    x, y = as_tensor(x), as_tensor(y)
+
+    def fn(a, b, box_normalized):
+        return _pairwise_iou(a, b, 0.0 if box_normalized else 1.0)
+
+    return eager_call("iou_similarity", fn, [x, y],
+                      {"box_normalized": bool(box_normalized)})
+
+
+def box_clip(boxes, img_shape, name=None):
+    """Clip xyxy boxes to image bounds (detection/box_clip_op.cc).
+    boxes: (..., 4); img_shape: (2,) [h, w]."""
+    boxes, img_shape = as_tensor(boxes), as_tensor(img_shape)
+
+    def fn(b, im):
+        h, w = im[0], im[1]
+        return jnp.stack([
+            jnp.clip(b[..., 0], 0, w - 1), jnp.clip(b[..., 1], 0, h - 1),
+            jnp.clip(b[..., 2], 0, w - 1), jnp.clip(b[..., 3], 0, h - 1),
+        ], axis=-1)
+
+    return eager_call("box_clip", fn, [boxes, img_shape])
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, stride,
+                     variances=(0.1, 0.1, 0.2, 0.2), offset=0.5, name=None):
+    """Dense anchors over a feature map (detection/anchor_generator_op.cc).
+    input: (N, C, H, W). Returns (anchors (H, W, A, 4), variances same)."""
+    input = as_tensor(input)
+
+    def fn(x, anchor_sizes, aspect_ratios, stride, variances, offset):
+        h, w = x.shape[2], x.shape[3]
+        cx = (jnp.arange(w, dtype=jnp.float32) + offset) * stride[0]
+        cy = (jnp.arange(h, dtype=jnp.float32) + offset) * stride[1]
+        shapes = []
+        for s in anchor_sizes:
+            for r in aspect_ratios:
+                bw = s * np.sqrt(r)
+                bh = s / np.sqrt(r)
+                shapes.append((bw, bh))
+        ws = jnp.asarray([sh[0] for sh in shapes], jnp.float32)
+        hs = jnp.asarray([sh[1] for sh in shapes], jnp.float32)
+        gx = cx[None, :, None]
+        gy = cy[:, None, None]
+        anchors = jnp.stack([
+            jnp.broadcast_to(gx - ws / 2, (h, w, len(shapes))),
+            jnp.broadcast_to(gy - hs / 2, (h, w, len(shapes))),
+            jnp.broadcast_to(gx + ws / 2, (h, w, len(shapes))),
+            jnp.broadcast_to(gy + hs / 2, (h, w, len(shapes))),
+        ], axis=-1)
+        var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), anchors.shape)
+        return anchors, var
+
+    return eager_call(
+        "anchor_generator", fn, [input],
+        {"anchor_sizes": tuple(float(s) for s in anchor_sizes),
+         "aspect_ratios": tuple(float(r) for r in aspect_ratios),
+         "stride": tuple(float(s) for s in (stride if isinstance(stride, (list, tuple)) else (stride, stride))),
+         "variances": tuple(float(v) for v in variances),
+         "offset": float(offset)},
+        differentiable=False,
+    )
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variances=(0.1, 0.1, 0.2, 0.2), clip=False, step=0.0,
+                      offset=0.5, name=None):
+    """Density prior boxes (detection/density_prior_box_op.cc): each density d
+    subdivides the cell into d x d shifted centers for every fixed size."""
+    input, image = as_tensor(input), as_tensor(image)
+
+    def fn(x, im, densities, fixed_sizes, fixed_ratios, variances, clip, step, offset):
+        h, w = x.shape[2], x.shape[3]
+        img_h, img_w = im.shape[2], im.shape[3]
+        step_x = step or img_w / w
+        step_y = step or img_h / h
+        boxes = []
+        for d, fs in zip(densities, fixed_sizes):
+            for r in fixed_ratios:
+                bw = fs * np.sqrt(r) / img_w
+                bh = fs / np.sqrt(r) / img_h
+                shift = 1.0 / d
+                for di in range(d):
+                    for dj in range(d):
+                        ox = (dj + 0.5) * shift - 0.5 + offset
+                        oy = (di + 0.5) * shift - 0.5 + offset
+                        cx = (jnp.arange(w, dtype=jnp.float32)[None, :] + ox) * step_x / img_w
+                        cy = (jnp.arange(h, dtype=jnp.float32)[:, None] + oy) * step_y / img_h
+                        boxes.append(jnp.stack([
+                            jnp.broadcast_to(cx - bw / 2, (h, w)),
+                            jnp.broadcast_to(cy - bh / 2, (h, w)),
+                            jnp.broadcast_to(cx + bw / 2, (h, w)),
+                            jnp.broadcast_to(cy + bh / 2, (h, w)),
+                        ], axis=-1))
+        out = jnp.stack(boxes, axis=2)  # (H, W, A, 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), out.shape)
+        return out, var
+
+    return eager_call(
+        "density_prior_box", fn, [input, image],
+        {"densities": tuple(int(d) for d in densities),
+         "fixed_sizes": tuple(float(s) for s in fixed_sizes),
+         "fixed_ratios": tuple(float(r) for r in fixed_ratios),
+         "variances": tuple(float(v) for v in variances),
+         "clip": bool(clip), "step": float(step), "offset": float(offset)},
+        differentiable=False,
+    )
+
+
+def bipartite_match(dist_mat, name=None):
+    """Greedy bipartite matching (detection/bipartite_match_op.cc): each
+    column matched to at most one row, best-first. dist: (N, M) similarity.
+    Returns (match_indices (M,) row per column or -1, match_dist (M,))."""
+    dist_mat = as_tensor(dist_mat)
+
+    def fn(d):
+        n, m = d.shape
+
+        def body(_, carry):
+            dd, idx, val = carry
+            flat = jnp.argmax(dd)
+            i, j = flat // m, flat % m
+            best = dd[i, j]
+            take = best > -jnp.inf
+            idx = jnp.where(take, idx.at[j].set(i), idx)
+            val = jnp.where(take, val.at[j].set(best), val)
+            dd = jnp.where(take, dd.at[i, :].set(-jnp.inf).at[:, j].set(-jnp.inf), dd)
+            return dd, idx, val
+
+        idx0 = jnp.full((m,), -1, jnp.asarray(0).dtype)  # follow x64 mode
+        val0 = jnp.zeros((m,), d.dtype)
+        _, idx, val = jax.lax.fori_loop(0, min(n, m), body, (d, idx0, val0))
+        return idx, val
+
+    return eager_call("bipartite_match", fn, [dist_mat], differentiable=False)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=100, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=-1, normalized=True,
+               name=None):
+    """Matrix NMS (detection/matrix_nms_op.cc; SOLOv2) — parallel score DECAY
+    instead of sequential suppression: TPU-native, fully static shapes.
+    bboxes: (N, 4); scores: (C, N) per-class. Returns (out (keep_top_k, 6)
+    [class, score, x1, y1, x2, y2], index (keep_top_k,), rois_num ())."""
+    bboxes, scores = as_tensor(bboxes), as_tensor(scores)
+
+    def fn(boxes, sc, score_threshold, post_threshold, nms_top_k, keep_top_k,
+           use_gaussian, gaussian_sigma, background_label, normalized):
+        c, n = sc.shape
+        k = min(nms_top_k, n)
+        off = 0.0 if normalized else 1.0
+
+        def per_class(cls_scores):
+            top_s, top_i = jax.lax.top_k(cls_scores, k)
+            b = boxes[top_i]
+            iou = _pairwise_iou(b, b, off)
+            # iou[i, j] for i < j: suppressor i (higher score) vs j
+            iou = jnp.triu(iou, 1)
+            # compensate_i: how suppressed box i itself already is
+            comp = jnp.max(iou, axis=0)
+            if use_gaussian:
+                decay = jnp.min(jnp.where(
+                    jnp.triu(jnp.ones((k, k), bool), 1),
+                    jnp.exp((comp[:, None] ** 2 - iou ** 2) / gaussian_sigma),
+                    jnp.inf), axis=0)
+            else:
+                decay = jnp.min(jnp.where(
+                    jnp.triu(jnp.ones((k, k), bool), 1),
+                    (1.0 - iou) / jnp.maximum(1.0 - comp[:, None], 1e-10),
+                    jnp.inf), axis=0)
+            decay = jnp.where(jnp.isfinite(decay), decay, 1.0)
+            s = top_s * decay
+            s = jnp.where(top_s > score_threshold, s, 0.0)
+            return s, top_i
+
+        cls_ids = jnp.arange(c)
+        dec_s, dec_i = jax.vmap(per_class)(sc)  # (C, k)
+        if background_label >= 0:
+            dec_s = dec_s.at[background_label].set(0.0)
+        flat_s = dec_s.reshape(-1)
+        flat_i = dec_i.reshape(-1)
+        flat_c = jnp.repeat(cls_ids, k)
+        kk = min(keep_top_k, flat_s.shape[0])
+        sel_s, sel = jax.lax.top_k(flat_s, kk)
+        sel_box = boxes[flat_i[sel]]
+        sel_c = flat_c[sel].astype(boxes.dtype)
+        ok = sel_s > post_threshold
+        out = jnp.concatenate(
+            [sel_c[:, None], sel_s[:, None], sel_box], axis=1)
+        out = jnp.where(ok[:, None], out, -1.0)
+        return out, jnp.where(ok, flat_i[sel], -1), ok.sum()
+
+    return eager_call(
+        "matrix_nms", fn, [bboxes, scores],
+        {"score_threshold": float(score_threshold),
+         "post_threshold": float(post_threshold),
+         "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+         "use_gaussian": bool(use_gaussian),
+         "gaussian_sigma": float(gaussian_sigma),
+         "background_label": int(background_label),
+         "normalized": bool(normalized)},
+        differentiable=False,
+    )
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=100,
+                   keep_top_k=100, nms_threshold=0.5, normalized=True,
+                   background_label=-1, name=None):
+    """Static-shape multiclass NMS (detection/multiclass_nms_op.cc): per-class
+    hard suppression emulated by score decay with threshold 1 (a box whose
+    IoU with any higher-scored kept box exceeds nms_threshold is zeroed),
+    computed as a fixed-point over the score-sorted triangular IoU matrix."""
+    bboxes, scores = as_tensor(bboxes), as_tensor(scores)
+
+    def fn(boxes, sc, score_threshold, nms_top_k, keep_top_k, nms_threshold,
+           normalized, background_label):
+        c, n = sc.shape
+        k = min(nms_top_k, n)
+        off = 0.0 if normalized else 1.0
+
+        def per_class(cls_scores):
+            top_s, top_i = jax.lax.top_k(cls_scores, k)
+            b = boxes[top_i]
+            iou = jnp.triu(_pairwise_iou(b, b, off), 1)
+            over = iou > nms_threshold
+
+            # sequential hard-NMS as a fori fixed point over sorted boxes:
+            # keep[i] iff no kept j<i overlaps i
+            def body(i, keep):
+                sup = jnp.any(over[:, i] & keep)
+                return keep.at[i].set(~sup & (top_s[i] > score_threshold))
+
+            keep = jax.lax.fori_loop(
+                0, k, body, jnp.zeros((k,), bool).at[0].set(top_s[0] > score_threshold))
+            return jnp.where(keep, top_s, 0.0), top_i
+
+        dec_s, dec_i = jax.vmap(per_class)(sc)
+        if background_label >= 0:
+            dec_s = dec_s.at[background_label].set(0.0)
+        flat_s = dec_s.reshape(-1)
+        flat_i = dec_i.reshape(-1)
+        flat_c = jnp.repeat(jnp.arange(c), k)
+        kk = min(keep_top_k, flat_s.shape[0])
+        sel_s, sel = jax.lax.top_k(flat_s, kk)
+        ok = sel_s > 0
+        out = jnp.concatenate([
+            flat_c[sel].astype(boxes.dtype)[:, None], sel_s[:, None],
+            boxes[flat_i[sel]]], axis=1)
+        out = jnp.where(ok[:, None], out, -1.0)
+        return out, jnp.where(ok, flat_i[sel], -1), ok.sum()
+
+    return eager_call(
+        "multiclass_nms", fn, [bboxes, scores],
+        {"score_threshold": float(score_threshold), "nms_top_k": int(nms_top_k),
+         "keep_top_k": int(keep_top_k), "nms_threshold": float(nms_threshold),
+         "normalized": bool(normalized), "background_label": int(background_label)},
+        differentiable=False,
+    )
+
+
+def target_assign(x, match_indices, mismatch_value=0, name=None):
+    """Gather per-column targets by match indices (detection/target_assign_op).
+    x: (N, D); match_indices: (M,) row ids or -1. Returns (out (M, D), weight
+    (M, 1))."""
+    x, match_indices = as_tensor(x), as_tensor(match_indices)
+
+    def fn(xv, mi, mismatch_value):
+        ok = mi >= 0
+        out = xv[jnp.clip(mi, 0, xv.shape[0] - 1)]
+        out = jnp.where(ok[:, None], out, jnp.asarray(mismatch_value, xv.dtype))
+        return out, ok.astype(xv.dtype)[:, None]
+
+    return eager_call("target_assign", fn, [x, match_indices],
+                      {"mismatch_value": float(mismatch_value)},
+                      differentiable=False)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh=0.7, downsample_ratio=32, use_label_smooth=False,
+                name=None):
+    """YOLOv3 training loss for one scale (detection/yolov3_loss_op.cc).
+    x: (N, A*(5+C), H, W); gt_box: (N, G, 4) xywh normalized to [0,1];
+    gt_label: (N, G) int (-1 pads). Objectness uses the best-anchor
+    assignment; predictions overlapping any gt above ignore_thresh are
+    excluded from the no-object loss."""
+    x, gt_box, gt_label = as_tensor(x), as_tensor(gt_box), as_tensor(gt_label)
+
+    def fn(xv, gb, gl, anchors, anchor_mask, class_num, ignore_thresh,
+           downsample_ratio, use_label_smooth):
+        n, _, h, w = xv.shape
+        a = len(anchor_mask)
+        xv = xv.reshape(n, a, 5 + class_num, h, w)
+        tx, ty = jax.nn.sigmoid(xv[:, :, 0]), jax.nn.sigmoid(xv[:, :, 1])
+        tw, th = xv[:, :, 2], xv[:, :, 3]
+        obj_logit = xv[:, :, 4]
+        cls_logit = xv[:, :, 5:]  # (N, A, C, H, W)
+        all_anchors = jnp.asarray(np.asarray(anchors, np.float32).reshape(-1, 2))
+        sel = all_anchors[jnp.asarray(list(anchor_mask))]  # (A, 2) pixels
+        img_size = downsample_ratio * jnp.asarray([w, h], jnp.float32)
+
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        px = (tx + gx) / w
+        py = (ty + gy) / h
+        pw = jnp.exp(jnp.clip(tw, -10, 10)) * sel[None, :, 0, None, None] / img_size[0]
+        ph = jnp.exp(jnp.clip(th, -10, 10)) * sel[None, :, 1, None, None] / img_size[1]
+
+        valid = gl >= 0  # (N, G)
+        # best anchor per gt (by shape IoU against ALL anchors, as reference)
+        gw = gb[..., 2] * img_size[0]
+        gh = gb[..., 3] * img_size[1]
+        inter = (jnp.minimum(gw[..., None], all_anchors[None, None, :, 0])
+                 * jnp.minimum(gh[..., None], all_anchors[None, None, :, 1]))
+        union = (gw * gh)[..., None] + (all_anchors[:, 0] * all_anchors[:, 1])[None, None, :] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # (N, G)
+        # cell of each gt
+        ci = jnp.clip((gb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+        cj = jnp.clip((gb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+        # build targets by scatter over (N, A, H, W)
+        tobj = jnp.zeros((n, a, h, w))
+        t_x = jnp.zeros((n, a, h, w)); t_y = jnp.zeros((n, a, h, w))
+        t_w = jnp.zeros((n, a, h, w)); t_h = jnp.zeros((n, a, h, w))
+        t_cls = jnp.zeros((n, a, class_num, h, w))
+        bscale = jnp.zeros((n, a, h, w))
+        bidx = jnp.arange(n)[:, None] * jnp.ones_like(best)
+        # which of OUR anchors (if any) is the best match
+        local = jnp.full_like(best, -1)
+        for li, am in enumerate(anchor_mask):
+            local = jnp.where(best == am, li, local)
+        ok = valid & (local >= 0)
+        la = jnp.clip(local, 0, a - 1)
+        tobj = tobj.at[bidx, la, cj, ci].max(ok.astype(tobj.dtype))
+        put = lambda t, v: t.at[bidx, la, cj, ci].add(jnp.where(ok, v, 0.0))
+        # duplicate gts in one (anchor, cell) AVERAGE their targets: a summed
+        # t_x of ~2 against a sigmoid output (and a BCE class target of 2)
+        # would reward unbounded logits in crowded scenes
+        cnt = jnp.maximum(
+            jnp.zeros((n, a, h, w)).at[bidx, la, cj, ci].add(ok.astype(jnp.float32)),
+            1.0)
+        t_x = put(t_x, gb[..., 0] * w - ci) / cnt
+        t_y = put(t_y, gb[..., 1] * h - cj) / cnt
+        t_w = put(t_w, jnp.log(jnp.maximum(gw / jnp.maximum(sel[la][..., 0], 1e-6), 1e-6))) / cnt
+        t_h = put(t_h, jnp.log(jnp.maximum(gh / jnp.maximum(sel[la][..., 1], 1e-6), 1e-6))) / cnt
+        bscale = put(bscale, 2.0 - gb[..., 2] * gb[..., 3]) / cnt
+        smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+        onehot = jax.nn.one_hot(jnp.clip(gl, 0, class_num - 1), class_num)
+        onehot = onehot * (1 - smooth) + smooth / class_num
+        t_cls = t_cls.at[bidx[..., None], la[..., None],
+                         jnp.arange(class_num)[None, None, :], cj[..., None],
+                         ci[..., None]].add(
+            jnp.where(ok[..., None], onehot, 0.0)) / cnt[:, :, None]
+
+        # ignore mask: predicted box IoU vs any gt > thresh
+        pb = jnp.stack([px - pw / 2, py - ph / 2, px + pw / 2, py + ph / 2], -1)
+        gbx = jnp.stack([gb[..., 0] - gb[..., 2] / 2, gb[..., 1] - gb[..., 3] / 2,
+                         gb[..., 0] + gb[..., 2] / 2, gb[..., 1] + gb[..., 3] / 2], -1)
+        pbf = pb.reshape(n, -1, 4)
+        iou = jax.vmap(_pairwise_iou)(pbf, gbx)
+        iou = jnp.where(valid[:, None, :], iou, 0.0)
+        ignore = (jnp.max(iou, -1) > ignore_thresh).reshape(n, a, h, w)
+
+        bce = lambda lg, t: jnp.maximum(lg, 0) - lg * t + jnp.log1p(jnp.exp(-jnp.abs(lg)))
+        loss_xy = (bscale * ((tx - t_x) ** 2 + (ty - t_y) ** 2) * tobj).sum((1, 2, 3))
+        loss_wh = (bscale * ((tw - t_w) ** 2 + (th - t_h) ** 2) * tobj).sum((1, 2, 3))
+        noobj = (1.0 - tobj) * (1.0 - ignore.astype(tobj.dtype))
+        loss_obj = (bce(obj_logit, tobj) * (tobj + noobj)).sum((1, 2, 3))
+        loss_cls = (bce(cls_logit, t_cls) * tobj[:, :, None]).sum((1, 2, 3, 4))
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    return eager_call(
+        "yolov3_loss", fn, [x, gt_box, gt_label],
+        {"anchors": tuple(float(v) for v in np.asarray(anchors).reshape(-1)),
+         "anchor_mask": tuple(int(m) for m in anchor_mask),
+         "class_num": int(class_num), "ignore_thresh": float(ignore_thresh),
+         "downsample_ratio": int(downsample_ratio),
+         "use_label_smooth": bool(use_label_smooth)},
+    )

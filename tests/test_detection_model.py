@@ -1,0 +1,139 @@
+"""YOLOv3 detection model + round-5 detection ops.
+
+Reference: detection op family (paddle/fluid/operators/detection/) and the
+PaddleDetection YOLO stack the BASELINE PP-YOLOE row comes from. Matrix NMS
+properties are checked against its paper semantics (score decay), the hard
+NMS against the host reference implementation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+from paddle_tpu.vision.models import YOLOv3, YOLOv3Postprocess
+
+
+def T(a):
+    return paddle.to_tensor(a)
+
+
+class TestDetectionOps:
+    def test_iou_similarity_values(self):
+        a = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+        b = np.array([[0, 0, 10, 10]], np.float32)
+        iou = np.asarray(vops.iou_similarity(T(a), T(b))._data)
+        assert abs(iou[0, 0] - 1.0) < 1e-6
+        assert abs(iou[1, 0] - 25.0 / 175.0) < 1e-5
+
+    def test_box_clip(self):
+        b = np.array([[-5, -5, 20, 20]], np.float32)
+        out = np.asarray(vops.box_clip(T(b), T(np.array([10.0, 12.0], np.float32)))._data)
+        np.testing.assert_allclose(out[0], [0, 0, 11, 9])
+
+    def test_anchor_generator_shapes_and_centers(self):
+        x = T(np.zeros((1, 8, 4, 6), np.float32))
+        a, v = vops.anchor_generator(x, [32.0], [1.0], [16, 16])
+        a = np.asarray(a._data)
+        assert a.shape == (4, 6, 1, 4)
+        # first cell center at offset*stride = 8 -> box [8-16, 8-16, 8+16, 8+16]
+        np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24], atol=1e-5)
+
+    def test_bipartite_match_greedy(self):
+        d = np.array([[0.9, 0.1], [0.8, 0.7]], np.float32)
+        idx, val = vops.bipartite_match(T(d))
+        # greedy: (0,0)=0.9 first, then (1,1)=0.7
+        assert list(np.asarray(idx._data)) == [0, 1]
+        np.testing.assert_allclose(np.asarray(val._data), [0.9, 0.7], atol=1e-6)
+
+    def test_matrix_nms_decays_duplicates(self):
+        boxes = np.array([[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5], [50, 50, 60, 60]], np.float32)
+        scores = np.array([[0.9, 0.85, 0.8]], np.float32)
+        out, idx, num = vops.matrix_nms(T(boxes), T(scores), score_threshold=0.05)
+        out = np.asarray(out._data)
+        # the far-away box must NOT be decayed: its score survives intact
+        kept = {round(float(s), 4) for s in out[:3, 1] if s > 0}
+        assert 0.9 in kept and 0.8 in kept
+        # the near-duplicate decays well below its original 0.85
+        dup = sorted(kept - {0.9, 0.8})
+        assert dup and dup[0] < 0.3
+
+    def test_multiclass_nms_matches_host_nms(self):
+        rng = np.random.RandomState(0)
+        base = rng.rand(8, 2) * 40
+        boxes = np.concatenate([base, base + 20 + rng.rand(8, 2) * 10], 1).astype(np.float32)
+        scores = rng.rand(1, 8).astype(np.float32)
+        out, idx, num = vops.multiclass_nms(
+            T(boxes), T(scores), score_threshold=0.0, nms_threshold=0.5)
+        got = sorted(int(i) for i in np.asarray(idx._data) if i >= 0)
+        keep_ref = np.asarray(vops.nms(T(boxes), 0.5, scores=T(scores[0]))._data)
+        assert got == sorted(keep_ref.tolist())
+
+    def test_target_assign(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        mi = np.array([1, -1, 2])
+        out, w = vops.target_assign(T(x), T(mi))
+        np.testing.assert_allclose(np.asarray(out._data)[0], x[1])
+        np.testing.assert_allclose(np.asarray(out._data)[1], 0)
+        assert list(np.asarray(w._data)[:, 0]) == [1, 0, 1]
+
+
+class TestYOLOv3:
+    def _tiny(self):
+        paddle.seed(0)
+        return YOLOv3(num_classes=4, depths=(1, 1, 1, 1, 1))
+
+    def test_forward_shapes(self):
+        m = self._tiny()
+        m.eval()
+        x = T(np.random.RandomState(0).randn(2, 3, 64, 64).astype(np.float32))
+        outs = m(x)
+        assert [tuple(o.shape) for o in outs] == [
+            (2, 27, 2, 2), (2, 27, 4, 4), (2, 27, 8, 8)]
+
+    def test_postprocess_static_shape(self):
+        m = self._tiny()
+        m.eval()
+        post = YOLOv3Postprocess(m, img_hw=(64, 64), keep_top_k=20)
+        x = T(np.random.RandomState(0).randn(2, 3, 64, 64).astype(np.float32))
+        dets = post(x)
+        assert tuple(dets.shape) == (2, 20, 6)
+
+    def test_loss_trains(self):
+        m = self._tiny()
+        m.train()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+        rng = np.random.RandomState(0)
+        x = T(rng.randn(2, 3, 64, 64).astype(np.float32) * 0.1)
+        gt = np.zeros((2, 3, 4), np.float32)
+        gt[:, 0] = [0.5, 0.5, 0.25, 0.4]
+        gl = np.full((2, 3), -1, np.int64)
+        gl[:, 0] = 1
+        losses = []
+        for _ in range(5):
+            loss = m.loss(x, T(gt), T(gl))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_aot_roundtrip_through_predictor(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+        from paddle_tpu.inference import Config, create_predictor
+
+        m = self._tiny()
+        m.eval()
+        post = YOLOv3Postprocess(m, img_hw=(64, 64), keep_top_k=10)
+        prefix = str(tmp_path / "yolo")
+        paddle.static.save_inference_model(
+            prefix, [InputSpec([1, 3, 64, 64], "float32", name="image")], post)
+        pred = create_predictor(Config(prefix))
+        x = np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        assert out.shape == (1, 10, 6)
+        want = np.asarray(post(T(x))._data)
+        np.testing.assert_allclose(out, want, atol=2e-3)

@@ -229,6 +229,11 @@ _REF_ENV = {
     "range": range,
     "tuple": tuple,
     "len": len,
+    "zip": zip,
+    "sum": sum,
+    "slice": slice,
+    "min": min,
+    "max": max,
 }
 
 
@@ -240,7 +245,11 @@ def _eval_ref(spec, inputs):
     else:
         for aname, val in zip(spec.get("args", ["x"]), inputs):
             env[aname] = np.asarray(val)
-    return eval(spec["ref"], {"__builtins__": {}}, env)  # noqa: S307
+    # env goes in GLOBALS: names inside lambda/genexp bodies resolve against
+    # eval's globals, not its locals. numpy keepdims reductions lazily
+    # __import__ internally, so that one builtin must be present.
+    return eval(  # noqa: S307
+        spec["ref"], {"__builtins__": {"__import__": __import__}, **env})
 
 
 _VALUE_SPECS = [n for n in sorted(SPECS) if SPECS[n].get("ref") and not SPECS[n].get("skip_test")]

@@ -1,0 +1,214 @@
+"""Sequence-op family (masked-ragged LoD equivalents) + one-shot metric ops.
+
+Reference methodology: unittests/sequence/test_sequence_*.py build LoD
+tensors and compare against python loops; here the padded+lengths pair is
+checked against the same per-row numpy loops.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import sequence as seq
+from paddle_tpu.ops import metrics_ops as mops
+
+
+RNG = np.random.RandomState(3)
+
+
+def ragged(b=3, t=6, d=2):
+    lens = RNG.randint(1, t + 1, (b,))
+    x = RNG.randn(b, t, d).astype(np.float32)
+    for i, l in enumerate(lens):
+        x[i, l:] = 0.0
+    return x, lens
+
+
+def T(a):
+    return paddle.to_tensor(a)
+
+
+class TestSequenceOps:
+    def test_pad_unpad_roundtrip(self):
+        x, lens = ragged()
+        flat_rows = np.concatenate([x[i, :l] for i, l in enumerate(lens)], 0)
+        flat = np.zeros((x.shape[0] * x.shape[1], x.shape[2]), np.float32)
+        flat[: flat_rows.shape[0]] = flat_rows
+        padded = seq.sequence_pad(T(flat), T(lens), max_len=x.shape[1])
+        np.testing.assert_allclose(np.asarray(padded._data), x, atol=1e-6)
+        unp = seq.sequence_unpad(T(x), T(lens))
+        np.testing.assert_allclose(np.asarray(unp._data), flat, atol=1e-6)
+
+    def test_softmax_masked(self):
+        x, lens = ragged()
+        out = np.asarray(seq.sequence_softmax(T(x), T(lens))._data)
+        for i, l in enumerate(lens):
+            e = np.exp(x[i, :l] - x[i, :l].max(0))
+            np.testing.assert_allclose(out[i, :l], e / e.sum(0), atol=1e-5)
+            assert np.all(out[i, l:] == 0)
+
+    @pytest.mark.parametrize("pt", ["SUM", "AVERAGE", "SQRT", "MAX", "MIN", "LAST", "FIRST"])
+    def test_pool(self, pt):
+        x, lens = ragged()
+        out = np.asarray(seq.sequence_pool(T(x), T(lens), pt)._data)
+        for i, l in enumerate(lens):
+            v = x[i, :l]
+            want = {
+                "SUM": v.sum(0), "AVERAGE": v.mean(0),
+                "SQRT": v.sum(0) / np.sqrt(l), "MAX": v.max(0),
+                "MIN": v.min(0), "LAST": v[-1], "FIRST": v[0],
+            }[pt]
+            np.testing.assert_allclose(out[i], want, atol=1e-5)
+
+    def test_reverse(self):
+        x, lens = ragged()
+        out = np.asarray(seq.sequence_reverse(T(x), T(lens))._data)
+        for i, l in enumerate(lens):
+            np.testing.assert_allclose(out[i, :l], x[i, :l][::-1], atol=1e-6)
+            np.testing.assert_allclose(out[i, l:], x[i, l:], atol=1e-6)
+
+    def test_expand_and_expand_as(self):
+        lens = np.array([2, 4, 1])
+        x = RNG.randn(3, 5).astype(np.float32)
+        out = np.asarray(seq.sequence_expand(T(x), T(lens), max_len=4)._data)
+        for i, l in enumerate(lens):
+            for t in range(4):
+                want = x[i] if t < l else np.zeros_like(x[i])
+                np.testing.assert_allclose(out[i, t], want, atol=1e-6)
+        y = np.zeros((3, 4, 5), np.float32)
+        out2 = np.asarray(seq.sequence_expand_as(T(x), T(y), T(lens))._data)
+        np.testing.assert_allclose(out2, out, atol=1e-6)
+
+    def test_concat(self):
+        x, lx = ragged()
+        y, ly = ragged()
+        vals, nl = seq.sequence_concat(T(x), T(lx), T(y), T(ly))
+        vals, nl = np.asarray(vals._data), np.asarray(nl._data)
+        for i in range(3):
+            want = np.concatenate([x[i, :lx[i]], y[i, :ly[i]]], 0)
+            assert nl[i] == lx[i] + ly[i]
+            np.testing.assert_allclose(vals[i, :nl[i]], want, atol=1e-6)
+            assert np.all(vals[i, nl[i]:] == 0)
+
+    def test_slice(self):
+        x, lens = ragged(t=8)
+        off = np.minimum(np.array([1, 2, 0]), np.maximum(lens - 1, 0))
+        sl = np.array([2, 3, 1])
+        vals, nl = seq.sequence_slice(T(x), T(lens), T(off), T(sl))
+        vals, nl = np.asarray(vals._data), np.asarray(nl._data)
+        for i in range(3):
+            want_len = min(sl[i], max(lens[i] - off[i], 0))
+            assert nl[i] == want_len
+            np.testing.assert_allclose(
+                vals[i, :want_len], x[i, off[i]:off[i] + want_len], atol=1e-6)
+
+    def test_erase(self):
+        ids = np.array([[3, 5, 3, 1, 0], [2, 2, 2, 9, 4]])
+        lens = np.array([4, 3])
+        vals, nl = seq.sequence_erase(T(ids), T(lens), tokens=[3, 2])
+        vals, nl = np.asarray(vals._data), np.asarray(nl._data)
+        assert list(nl) == [2, 0]
+        assert list(vals[0, :2]) == [5, 1]
+
+    def test_enumerate(self):
+        ids = np.array([[1, 2, 3, 4], [5, 6, 0, 0]])
+        lens = np.array([4, 2])
+        out = np.asarray(seq.sequence_enumerate(T(ids), T(lens), win_size=2, pad_value=0)._data)
+        assert list(out[0, 0]) == [1, 2]
+        assert list(out[0, 3]) == [4, 0]  # window walks off the row
+        assert list(out[1, 1]) == [6, 0]
+
+    def test_reshape(self):
+        x = RNG.randn(2, 4, 6).astype(np.float32)
+        lens = np.array([2, 4])
+        vals, nl = seq.sequence_reshape(T(x), T(lens), new_dim=3)
+        assert list(np.asarray(nl._data)) == [4, 8]
+        assert np.asarray(vals._data).shape == (2, 8, 3)
+
+    def test_scatter(self):
+        x = np.zeros((2, 5), np.float32)
+        idx = np.array([[0, 2], [1, 1]])
+        upd = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        ulen = np.array([2, 1])
+        out = np.asarray(seq.sequence_scatter(T(x), T(idx), T(upd), T(ulen))._data)
+        assert out[0, 0] == 1.0 and out[0, 2] == 2.0
+        assert out[1, 1] == 3.0  # second update masked out by ulen=1
+
+    def test_topk_avg_pooling(self):
+        x = np.array([[5.0, 1.0, 3.0, 0.0], [2.0, 2.0, 0.0, 0.0]], np.float32)
+        lens = np.array([3, 2])
+        out = np.asarray(seq.sequence_topk_avg_pooling(T(x), T(lens), topks=[1, 2])._data)
+        np.testing.assert_allclose(out[0], [5.0, 4.0], atol=1e-5)
+        np.testing.assert_allclose(out[1], [2.0, 2.0], atol=1e-5)
+
+    def test_conv(self):
+        x, lens = ragged(d=3)
+        w = RNG.randn(9, 4).astype(np.float32)  # ctx=3
+        out = np.asarray(seq.sequence_conv(T(x), T(lens), T(w))._data)
+        b, t, d = x.shape
+        for i, l in enumerate(lens):
+            xm = x[i].copy(); xm[l:] = 0
+            for tt in range(l):
+                ctx = []
+                for c in range(3):
+                    p = tt + (-1 + c)
+                    ctx.append(xm[p] if 0 <= p < l else np.zeros(d, np.float32))
+                want = np.concatenate(ctx) @ w
+                np.testing.assert_allclose(out[i, tt], want, atol=1e-4)
+            assert np.all(out[i, l:] == 0)
+
+    def test_grad_through_pool(self):
+        x, lens = ragged()
+        xt = T(x)
+        xt.stop_gradient = False
+        loss = seq.sequence_pool(xt, T(lens), "AVERAGE").sum()
+        loss.backward()
+        g = np.asarray(xt.grad._data)
+        for i, l in enumerate(lens):
+            np.testing.assert_allclose(g[i, :l], np.full((l, x.shape[2]), 1.0 / l), atol=1e-5)
+            assert np.all(g[i, l:] == 0)
+
+
+class TestMetricOps:
+    def test_auc_rank(self):
+        pred = np.array([0.1, 0.9, 0.4, 0.8, 0.3], np.float32)
+        label = np.array([0, 1, 0, 1, 1])
+        got = float(mops.auc(T(pred), T(label))._data)
+        # pairwise reference
+        pos = pred[label == 1]; neg = pred[label == 0]
+        want = np.mean([(p > n) + 0.5 * (p == n) for p in pos for n in neg])
+        assert abs(got - want) < 1e-6
+
+    def test_edit_distance(self):
+        hyp = np.array([[1, 2, 3, 0], [4, 4, 0, 0]])
+        hl = np.array([3, 2])
+        ref = np.array([[1, 3, 3, 5], [4, 0, 0, 0]])
+        rl = np.array([4, 1])
+        d = np.asarray(mops.edit_distance(T(hyp), T(hl), T(ref), T(rl), normalized=False)._data)
+        assert d[0] == 2.0  # sub 2->3, insert 5
+        assert d[1] == 1.0  # delete one 4
+        dn = np.asarray(mops.edit_distance(T(hyp), T(hl), T(ref), T(rl))._data)
+        np.testing.assert_allclose(dn, [2.0 / 4, 1.0], atol=1e-6)
+
+    def test_mean_iou(self):
+        pred = np.array([0, 0, 1, 1, 2])
+        label = np.array([0, 1, 1, 1, 2])
+        got = float(mops.mean_iou(T(pred), T(label), 3)._data)
+        # class0: i1/u2, class1: i2/u3, class2: 1/1
+        want = (0.5 + 2 / 3 + 1.0) / 3
+        assert abs(got - want) < 1e-6
+
+    def test_precision_recall(self):
+        pred = np.array([0, 1, 1, 0])
+        label = np.array([0, 1, 0, 0])
+        p, r, f1 = mops.precision_recall(T(pred), T(label), 2)
+        # class0: tp2 fp0 fn1 -> p=1, r=2/3; class1: tp1 fp1 fn0 -> p=.5, r=1
+        assert abs(float(p._data) - 0.75) < 1e-6
+        assert abs(float(r._data) - (2 / 3 + 1) / 2) < 1e-6
+        assert float(f1._data) > 0
+
+    def test_positive_negative_pair(self):
+        score = np.array([0.8, 0.2, 0.5, 0.6], np.float32)
+        label = np.array([1.0, 0.0, 0.0, 1.0], np.float32)
+        qid = np.array([0, 0, 1, 1])
+        pos, neg, neu = mops.positive_negative_pair(T(score), T(label), T(qid))
+        assert int(pos._data) == 2 and int(neg._data) == 0 and int(neu._data) == 0
