@@ -148,6 +148,9 @@ def bench_gpt_8k_flash(paddle, jax, np, on_tpu):
         max_position_embeddings=8192, hidden_dropout=0.0,
         attention_dropout=0.0, attention_impl="flash", remat=False,
         use_mp_layers=False,
+        # round-5 A/B: at b2s8192 the full activation set fits HBM, and the
+        # unfused CE measured 41.1k vs 39.2k tok/s fused (+5%)
+        fused_lm_loss=False,
     )
     batch, seq, steps = 2, 8192, 10
     tps, n_params, final = _gpt_train_tokens_per_sec(paddle, np, cfg, batch, seq, steps)
@@ -227,17 +230,22 @@ def bench_resnet50_aot(paddle, jax, np, on_tpu):
 
 def bench_resnet50_int8(paddle, jax, np, on_tpu):
     """ResNet-50 int8 serving (PTQ → int8 swap → bf16 inter-layer flow →
-    Predictor) vs the bf16 AOT number above — the slim→AnalysisPredictor
-    int8 capability. int8 convs accumulate in int32 on the MXU; the non-conv
-    glue (BN/relu/pool) runs bf16 so activation traffic stays halved."""
+    Predictor) — the slim→AnalysisPredictor int8 capability.
+
+    PAIRED measurement: int8 and bf16 predictors run in ALTERNATING timed
+    segments, so host/tunnel load variance hits both equally and the
+    reported ``int8_speedup`` is load-invariant (round-4's driver run showed
+    1.003x while idle runs showed 1.23x — pure per-run dispatch variance).
+    Ceiling note (round-5 microbench, committed): XLA int8 convs on v5e run
+    1.1-1.3x their bf16 counterparts (e.g. 3x3 512ch: 91.7 TOP/s vs 71.8
+    TFLOP/s), NOT the 2x the 394-TOPS peak implies — the serving speedup is
+    bounded by that, and b256 int8 conv lowering REGRESSES (0.81x), so b64
+    is the serving batch."""
     from paddle_tpu.vision.models import resnet50
     from paddle_tpu.static import InputSpec
     from paddle_tpu.inference import Config, create_predictor
     from paddle_tpu.quantization import PostTrainingQuantization, convert_to_int8_inference
 
-    paddle.seed(0)
-    model = resnet50()
-    model.eval()
     batch = 64 if on_tpu else 4
     steps = 20 if on_tpu else 3
 
@@ -248,36 +256,52 @@ def bench_resnet50_int8(paddle, jax, np, on_tpu):
         def __getitem__(self, i):
             return np.random.RandomState(i).randn(3, 224, 224).astype(np.float32)
 
-    loader = paddle.io.DataLoader(Calib(), batch_size=2, num_workers=0)
-    ptq = PostTrainingQuantization(model, data_loader=loader, batch_nums=1)
-    ptq.quantize()
-    convert_to_int8_inference(model, ptq)
-    model = _bf16_wrap(paddle, model)  # int8 weights untouched (non-float)
+    def build(int8):
+        paddle.seed(0)
+        model = resnet50()
+        model.eval()
+        if int8:
+            loader = paddle.io.DataLoader(Calib(), batch_size=2, num_workers=0)
+            ptq = PostTrainingQuantization(model, data_loader=loader, batch_nums=1)
+            ptq.quantize()
+            convert_to_int8_inference(model, ptq)
+        model = _bf16_wrap(paddle, model)  # int8 weights untouched (non-float)
+        d = tempfile.mkdtemp()
+        prefix = os.path.join(d, "resnet50_q" if int8 else "resnet50_f")
+        paddle.static.save_inference_model(
+            prefix, [InputSpec([batch, 3, 224, 224], "float32", name="image")], model
+        )
+        pred = create_predictor(Config(prefix))
+        shutil.rmtree(d, ignore_errors=True)
+        x = np.random.RandomState(0).randn(batch, 3, 224, 224).astype(np.float32)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.share_external_data(jax.device_put(jax.numpy.asarray(x)))
+        out_h = pred.get_output_handle(pred.get_output_names()[0])
+        pred.run(); out_h.copy_to_cpu()
+        pred.run(); out_h.copy_to_cpu()
+        return pred, out_h
 
-    d = tempfile.mkdtemp()
-    prefix = os.path.join(d, "resnet50_int8")
-    paddle.static.save_inference_model(
-        prefix, [InputSpec([batch, 3, 224, 224], "float32", name="image")], model
-    )
-    pred = create_predictor(Config(prefix))
-    shutil.rmtree(d, ignore_errors=True)
-    x = np.random.RandomState(0).randn(batch, 3, 224, 224).astype(np.float32)
-    h = pred.get_input_handle(pred.get_input_names()[0])
-    h.share_external_data(jax.device_put(jax.numpy.asarray(x)))
-    out_h = pred.get_output_handle(pred.get_output_names()[0])
-    pred.run(); out_h.copy_to_cpu()
-    pred.run(); out_h.copy_to_cpu()
-    dt = None
-    for _ in range(2):  # best-of-2: sheds one-off host/tunnel stalls
+    pred_q, out_q = build(True)
+    pred_f, out_f = build(False)
+
+    def segment(pred, out_h):
         t0 = time.time()
         for _ in range(steps):
             pred.run()
         out_h.copy_to_cpu().sum()
-        elapsed = time.time() - t0
-        dt = elapsed if dt is None else min(dt, elapsed)
+        return time.time() - t0
+
+    dt_q = dt_f = None
+    for _ in range(3):  # alternating best-of-3: load-paired A/B
+        e_q = segment(pred_q, out_q)
+        e_f = segment(pred_f, out_f)
+        dt_q = e_q if dt_q is None else min(dt_q, e_q)
+        dt_f = e_f if dt_f is None else min(dt_f, e_f)
     return {
-        "name": f"ResNet-50 int8 AOT inference (b{batch}, Predictor)",
-        "imgs_per_sec": round(batch * steps / dt, 1),
+        "name": f"ResNet-50 int8 AOT inference (b{batch}, Predictor, paired A/B)",
+        "imgs_per_sec": round(batch * steps / dt_q, 1),
+        "bf16_paired_imgs_per_sec": round(batch * steps / dt_f, 1),
+        "int8_speedup": round(dt_f / dt_q, 3),
     }
 
 
@@ -349,6 +373,49 @@ def bench_vit_l_aot(paddle, jax, np, on_tpu):
         dt = elapsed if dt is None else min(dt, elapsed)
     return {
         "name": f"ViT-L/16 bf16 AOT inference (b{batch}, Predictor)",
+        "imgs_per_sec": round(batch * steps / dt, 1),
+    }
+
+
+def bench_yolov3_aot(paddle, jax, np, on_tpu):
+    """YOLOv3-DarkNet53 bf16 AOT detection inference (the PP-YOLOE BASELINE
+    row's YOLO-family point): backbone + FPN heads + yolo_box decode +
+    matrix NMS, ALL in one static-shape Predictor graph."""
+    from paddle_tpu.vision.models import yolov3_darknet53, YOLOv3Postprocess
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.inference import Config, create_predictor
+
+    if not on_tpu:
+        return {"name": "YOLOv3 AOT", "skipped": "cpu"}
+    paddle.seed(0)
+    model = yolov3_darknet53(num_classes=80)
+    model.eval()
+    post = YOLOv3Postprocess(model, img_hw=(416, 416))
+    post = _bf16_wrap(paddle, post)
+    batch, steps = 8, 20
+    d = tempfile.mkdtemp()
+    prefix = os.path.join(d, "yolov3")
+    paddle.static.save_inference_model(
+        prefix, [InputSpec([batch, 3, 416, 416], "float32", name="image")], post
+    )
+    pred = create_predictor(Config(prefix))
+    shutil.rmtree(d, ignore_errors=True)
+    x = np.random.RandomState(0).randn(batch, 3, 416, 416).astype(np.float32)
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.share_external_data(jax.device_put(jax.numpy.asarray(x)))
+    out_h = pred.get_output_handle(pred.get_output_names()[0])
+    pred.run(); out_h.copy_to_cpu()
+    pred.run(); out_h.copy_to_cpu()
+    dt = None
+    for _ in range(2):
+        t0 = time.time()
+        for _ in range(steps):
+            pred.run()
+        out_h.copy_to_cpu().sum()
+        elapsed = time.time() - t0
+        dt = elapsed if dt is None else min(dt, elapsed)
+    return {
+        "name": f"YOLOv3-DarkNet53 bf16 AOT detection (b{batch}x416, Predictor+matrixNMS)",
         "imgs_per_sec": round(batch * steps / dt, 1),
     }
 
@@ -454,7 +521,7 @@ def main():
     extras = []
     for fn in (bench_resnet50_aot, bench_resnet50_int8, bench_lenet_eager,
                bench_gpt_1p3b, bench_gpt_8k_flash, bench_vit_l_aot,
-               bench_llama_1b, bench_host_embedding):
+               bench_yolov3_aot, bench_llama_1b, bench_host_embedding):
         try:
             extras.append(fn(paddle, jax, np, on_tpu))
         except Exception as e:  # a broken extra must not kill the primary line

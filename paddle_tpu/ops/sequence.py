@@ -117,9 +117,12 @@ def sequence_pool(x, length, pool_type="SUM", name=None):
             empty = (lv == 0).reshape((-1,) + (1,) * (xv.ndim - 2))
             return jnp.where(empty, jnp.zeros_like(out), out)
         idx = (lv - 1 if pt == "LAST" else jnp.zeros_like(lv))
-        return jnp.take_along_axis(
+        out = jnp.take_along_axis(
             xv, jnp.clip(idx, 0, t - 1).reshape((-1, 1) + (1,) * (xv.ndim - 2)), axis=1
         )[:, 0]
+        # zero-length rows would otherwise leak x[i, 0] padding garbage
+        empty = (lv == 0).reshape((-1,) + (1,) * (xv.ndim - 2))
+        return jnp.where(empty, jnp.zeros_like(out), out)
 
     return eager_call("sequence_pool", fn, [x, length], {"pt": pt})
 

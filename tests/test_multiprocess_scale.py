@@ -1,0 +1,255 @@
+"""Multi-process scale beyond world=2 (VERDICT r4 item 5).
+
+Reference methodology: ``test_dist_base.py:1032`` runs N-proc clusters and
+checks loss parity with the single-process run; ``fleet/launch_utils.py``
+handles real multi-node topologies. Here: 4- and 8-process CPU
+``jax.distributed`` jobs through the package's own bootstrap
+(``init_parallel_env``), the sharded host-embedding PS at world=4, and an
+elastic scale-down mid-train with checkpoint resume at the smaller world.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env(rank, world, coord_port, extra=None):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "XLA_FLAGS")}
+    env.update({
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_TPU_COORDINATOR": f"127.0.0.1:{coord_port}",
+    })
+    env.update(extra or {})
+    return env
+
+
+DP_WORKER = textwrap.dedent(
+    """
+    import os, json
+    import numpy as np
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    from paddle_tpu.distributed import parallel_env
+
+    env = parallel_env.init_parallel_env()
+    assert env.world_size == world, env.world_size
+    import jax, jax.numpy as jnp
+
+    # data-parallel least squares: each rank holds 1/world of the batch;
+    # grads all-reduce over the process world (1 device per proc)
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 8).astype(np.float32)
+    Y = X @ rng.randn(8, 1).astype(np.float32)
+    shard = slice(rank * (32 // world), (rank + 1) * (32 // world))
+    Xs, Ys = jnp.asarray(X[shard]), jnp.asarray(Y[shard])
+    w = jnp.zeros((8, 1), jnp.float32)
+    # pmap IS the jit: 1 local device per proc, psum spans the process world
+    allreduce = jax.pmap(lambda g: jax.lax.psum(g, "i"), axis_name="i")
+    gradf = jax.jit(jax.grad(lambda w, x, y: jnp.mean((x @ w - y) ** 2)))
+
+    for _ in range(5):
+        g = allreduce(gradf(w, Xs, Ys)[None])[0] / world
+        w = w - 0.1 * g
+    print(json.dumps({"rank": rank, "w0": float(w[0, 0]), "wsum": float(jnp.sum(w))}), flush=True)
+    """
+)
+
+
+def _run_world(worker, world, extra=None, timeout=300):
+    coord = _free_port()
+    procs = [
+        subprocess.Popen([sys.executable, "-c", worker],
+                         env=_env(r, world, coord, extra),
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(world)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=timeout)
+        assert p.returncode == 0, out.decode()[-3000:]
+        outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+    return outs
+
+
+class TestWorldScale:
+    @pytest.mark.parametrize("world", [4, 8])
+    def test_dp_train_parity(self, world):
+        outs = _run_world(DP_WORKER, world)
+        # every rank converges to the SAME weights...
+        wsums = [o["wsum"] for o in outs]
+        assert max(wsums) - min(wsums) < 1e-5, wsums
+        # ...equal to the single-process full-batch run
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 8).astype(np.float32)
+        Y = X @ rng.randn(8, 1).astype(np.float32)
+        w = np.zeros((8, 1), np.float32)
+        for _ in range(5):
+            g = 2 * X.T @ (X @ w - Y) / len(X)
+            w = w - 0.1 * g
+        np.testing.assert_allclose(wsums[0], float(w.sum()), rtol=1e-4)
+
+
+EMB_WORKER = textwrap.dedent(
+    """
+    import os, json
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.host_embedding import (
+        ShardedHostEmbeddingTable, sharded_host_embedding,
+    )
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    emb = sharded_host_embedding(128, 8, seed=3)
+    assert isinstance(emb.table, ShardedHostEmbeddingTable)
+    losses = []
+    for step in range(3):
+        rng = np.random.RandomState(50 + step)
+        ids = rng.randint(0, 128, (4, 5))
+        out = emb(paddle.to_tensor(ids))
+        loss = paddle.sum(out * out)
+        loss.backward()
+        emb.apply_gradients(lr=0.1)
+        losses.append(float(loss.numpy()))
+    print(json.dumps({"rank": rank, "losses": losses}), flush=True)
+    """
+)
+
+
+class TestShardedEmbeddingWorld4:
+    def test_world4_parity_with_single_table(self):
+        from paddle_tpu.core.native import lib
+
+        if lib() is None:
+            pytest.skip("native runtime not built")
+        world = 4
+        outs = _run_world(EMB_WORKER, world,
+                          extra={"PADDLE_EMB_STORE_PORT": str(_free_port())})
+        for o in outs[1:]:
+            assert o["losses"] == outs[0]["losses"], outs
+
+        from paddle_tpu.incubate.host_embedding import HostEmbedding
+        import paddle_tpu as paddle
+
+        emb = HostEmbedding(128, 8, seed=3)
+        ref = []
+        for step in range(3):
+            rng = np.random.RandomState(50 + step)
+            ids = rng.randint(0, 128, (4, 5))
+            out = emb(paddle.to_tensor(ids))
+            loss = paddle.sum(out * out)
+            loss.backward()
+            # 4 ranks pushed identical grads -> 4x summed update
+            for uniq, rows in emb._pending:
+                if rows.grad is not None:
+                    rows.grad._set_data(rows.grad._data * float(world))
+            emb.apply_gradients(lr=0.1)
+            ref.append(float(loss.numpy()))
+        np.testing.assert_allclose(outs[0]["losses"], ref, rtol=1e-5)
+
+
+ELASTIC_WORKER = textwrap.dedent(
+    """
+    import os, json, sys
+    import numpy as np
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    ckpt = os.environ["CKPT_PATH"]
+    die_at = int(os.environ.get("DIE_AT_STEP", "-1"))
+    from paddle_tpu.distributed import parallel_env
+
+    parallel_env.init_parallel_env()
+    import jax, jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 8).astype(np.float32)
+    Y = X @ rng.randn(8, 1).astype(np.float32)
+    per = 32 // world
+    Xs = jnp.asarray(X[rank * per:(rank + 1) * per])
+    Ys = jnp.asarray(Y[rank * per:(rank + 1) * per])
+
+    # resume: AutoCheckpoint-style — pick up step/weights if present
+    start, w = 0, jnp.zeros((8, 1), jnp.float32)
+    if os.path.exists(ckpt):
+        data = np.load(ckpt)
+        start, w = int(data["step"]), jnp.asarray(data["w"])
+
+    allreduce = jax.pmap(lambda g: jax.lax.psum(g, "i"), axis_name="i")
+    gradf = jax.jit(jax.grad(lambda w, x, y: jnp.mean((x @ w - y) ** 2)))
+
+    for step in range(start, 6):
+        if rank == world - 1 and die_at >= 0 and step == die_at:
+            os._exit(17)  # hard exit: sys.exit would hang in jax.distributed's atexit shutdown barrier
+        w = w - 0.1 * allreduce(gradf(w, Xs, Ys)[None])[0] / world
+        if rank == 0:
+            np.savez(ckpt, step=step + 1, w=np.asarray(w))
+    print(json.dumps({"rank": rank, "world": world, "wsum": float(jnp.sum(w))}), flush=True)
+    """
+)
+
+
+class TestElasticScaleDown:
+    def test_scale_down_mid_train_resumes_at_world3(self, tmp_path):
+        """4-proc job loses a worker at step 2; the elastic supervisor
+        relaunches at world=3 and training RESUMES from the checkpoint
+        (reference: elastic/manager.py scale-in + AutoCheckpoint resume)."""
+        ckpt = str(tmp_path / "ckpt.npz")
+
+        def launch(world, die_at):
+            coord = _free_port()
+            return [
+                subprocess.Popen(
+                    [sys.executable, "-c", ELASTIC_WORKER],
+                    env=_env(r, world, coord,
+                             {"CKPT_PATH": ckpt, "DIE_AT_STEP": str(die_at)}),
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+                for r in range(world)
+            ]
+
+        procs = launch(4, die_at=2)
+        # the failing rank exits; survivors BLOCK in the dead collective —
+        # exactly why the elastic supervisor kills and relaunches the world
+        assert procs[-1].wait(timeout=300) == 17
+        time.sleep(1.0)
+        for p in procs[:-1]:
+            p.kill()  # SIGKILL: blocked in gloo, SIGTERM is ignored
+        for p in procs[:-1]:
+            p.wait(timeout=60)
+        assert os.path.exists(ckpt)  # progress survived
+        step_before = int(np.load(ckpt)["step"])
+        assert 1 <= step_before < 6
+
+        # supervisor decision: scale down to the 3 survivors and resume
+        procs = launch(3, die_at=-1)
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            assert p.returncode == 0, out.decode()[-3000:]
+            outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+        assert all(o["world"] == 3 for o in outs)
+        # resumed run completes all 6 steps and converges like 1-proc SGD
+        # seeded from the same checkpointed trajectory
+        assert int(np.load(ckpt)["step"]) == 6
+        wsums = [o["wsum"] for o in outs]
+        assert max(wsums) - min(wsums) < 1e-5
