@@ -35,9 +35,21 @@ def bench_gpt(paddle, jax, np, on_tpu):
             # Round-4 optimization search (interleaved in-process A/B, hard
             # syncs): flash-vs-exact attention ±0.1%, fused CE −5%, b16/b32
             # batches −5..−50% (exact attn collapses at b16+; flash holds),
-            # optimizer+dispatch ≈ 0 ms (full step == fwd+bwd time). The
-            # config is at its practical XLA plateau ~0.53 MFU; further gains
-            # need a fused transformer-layer kernel.
+            # optimizer+dispatch ≈ 0 ms (full step == fwd+bwd time).
+            # Round-5 decomposition of the 185 ms step (raw-jax replica,
+            # per-component ablations on-chip): matmul core 91 ms at 82% of
+            # peak, attention 68 ms (37% of step for 6.6% of FLOPs), head+CE
+            # 28 ms, LN 7 ms, gelu 2 ms. The flash kernel itself accounts
+            # for ~48 ms and already beats stock jax pallas flash 3.6x and
+            # splash 3.7x at this shape; the round-5 kernel A/B sweep
+            # (multi-row programs, chunk-fused loops, native-layout two-pass,
+            # streamed grid, merged backward — all committed behind flags in
+            # ops/pallas/flash_attention.py) found the per-head D=64 score
+            # matmul pinned near 30 TF/s at short T regardless of structure
+            # (the same matmul reaches ~95 TF/s in steady state at T>=4096).
+            # The remaining "fused transformer layer" levers (projections
+            # inside the kernel) would trade 82%-efficient XLA matmuls for
+            # that same pinned regime — the committed A/Bs say it loses.
             fused_lm_loss=False,
         )
         # 30 timed steps: at ~190ms/step the ±4% run-to-run variance seen at
@@ -138,7 +150,12 @@ def bench_gpt_8k_flash(paddle, jax, np, on_tpu):
     """Long-sequence point: 8k tokens through the Pallas flash-attention
     kernel (fwd+bwd), where exact attention's T² scores would dominate.
     No remat: flash keeps activations small enough to skip the recompute
-    tax even at 8k (measured MFU 0.38 vs 0.30 with remat)."""
+    tax even at 8k (measured MFU 0.38 vs 0.30 with remat). Round-5: unfused
+    CE +5% (41.1k vs 39.2k tok/s); attention is 66% of the step here and
+    the kernel (12.6 ms/layer fwd+bwd) beats stock jax flash 6.5x and
+    splash 8.6x at this shape — the PV/dq matmuls' N=64 lane ceiling
+    (~50 TF/s) bounds further gains, so ~0.39-0.41 MFU is the honest
+    plateau for D=64 heads on v5e."""
     from paddle_tpu.models.gpt import GPTConfig
 
     if not on_tpu:
