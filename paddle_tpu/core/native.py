@@ -25,6 +25,19 @@ def _build():
     subprocess.run(["make", "-C", _RUNTIME_DIR], check=True, capture_output=True)
 
 
+def _stale() -> bool:
+    """True when any runtime source is newer than the built .so."""
+    try:
+        so_m = os.path.getmtime(_SO)
+        for name in os.listdir(_RUNTIME_DIR):
+            if name.endswith((".cc", ".h")) and os.path.getmtime(
+                    os.path.join(_RUNTIME_DIR, name)) > so_m:
+                return True
+    except OSError:
+        return False
+    return False
+
+
 def lib():
     """Load (building if needed) the native runtime; None if unavailable."""
     global _lib
@@ -34,7 +47,11 @@ def lib():
         if _lib is not None:
             return _lib
         try:
-            if not os.path.exists(_SO):
+            if not os.path.exists(_SO) or _stale():
+                # make's own mtime check keeps the rebuild a no-op when
+                # nothing changed; calling it whenever a source is newer
+                # means an upgraded checkout can't load a stale .so that
+                # lacks newly added symbols
                 _build()
             L = ctypes.CDLL(_SO)
         except Exception:

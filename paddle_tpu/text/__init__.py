@@ -12,8 +12,9 @@ import jax.numpy as jnp
 from ..core.dispatch import as_tensor, eager_call
 
 from .datasets import Imdb, Imikolov, UCIHousing, Conll05st, Movielens, WMT14, WMT16  # noqa: F401,E402
+from .faster_tokenizer import FasterTokenizer  # noqa: F401,E402
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "FasterTokenizer", "Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths, include_bos_eos_tag=True, name=None):

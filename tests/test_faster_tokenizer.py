@@ -1,0 +1,101 @@
+"""FasterTokenizer — native C++ tokenizer vs the pure-Python twin.
+
+Reference: operators/string/faster_tokenizer_op.cc +
+test_faster_tokenizer_op.py methodology (text → padded id/seg tensors,
+batch + pair encoding, truncation).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import FasterTokenizer
+from paddle_tpu.text.faster_tokenizer import _basic_tokenize, _wordpiece
+
+
+VOCAB = {t: i for i, t in enumerate([
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]",
+    "un", "##aff", "##able", "want", "##ed", "wa", "##nt", "the", "runn",
+    "##ing", "hello", "world", ",", "!", "好", "你",
+])}
+
+
+def make(native=True):
+    tok = FasterTokenizer(VOCAB)
+    if not native:
+        tok._handle = None  # force the python twin
+    return tok
+
+
+class TestWordpiece:
+    def test_greedy_longest_match(self):
+        # canonical BERT wordpiece example
+        assert _wordpiece("unaffable", VOCAB, 1) == [
+            VOCAB["un"], VOCAB["##aff"], VOCAB["##able"]]
+        assert _wordpiece("wanted", VOCAB, 1) == [VOCAB["want"], VOCAB["##ed"]]
+        # "unwanted": after "un", no "##wa..."-prefixed piece exists -> UNK
+        assert _wordpiece("unwanted", VOCAB, 1) == [1]
+        assert _wordpiece("xyz", VOCAB, 1) == [1]  # UNK
+
+    def test_basic_tokenize_splits(self):
+        assert _basic_tokenize("Hello, World!", True) == [
+            "hello", ",", "world", "!"]
+        assert _basic_tokenize("你好", True) == ["你", "好"]
+        assert _basic_tokenize("a\x00b\x07c", True) == ["abc"]
+
+
+class TestNativeParity:
+    def test_native_available(self):
+        tok = make()
+        if not tok.is_native:
+            pytest.skip("native runtime not built")
+
+    @pytest.mark.parametrize("text", [
+        "Hello, World! unaffable wanted",
+        "你好 world",
+        "the running UNAFFABLE",
+        "punct...everywhere!!!",
+        "",
+        "café unaffable",  # combining accent: non-ascii word -> UNK both sides
+        "x" * 150,  # over the 100-byte wordpiece cap
+        "hello\x00world",  # NUL: both backends stop at the C-string boundary
+    ])
+    def test_ids_match_python_twin(self, text):
+        tok_n, tok_p = make(True), make(False)
+        if not tok_n.is_native:
+            pytest.skip("native runtime not built")
+        assert tok_n._encode_one(text) == tok_p._encode_one(text), text
+
+    def test_batch_pair_encoding(self):
+        tok = make()
+        ids, segs = tok(["hello world", "unaffable"],
+                        text_pair=["wanted", "the running"], max_seq_len=12)
+        ids, segs = np.asarray(ids._data), np.asarray(segs._data)
+        assert ids.shape == (2, 12) and segs.shape == (2, 12)
+        assert ids[0, 0] == VOCAB["[CLS]"]
+        row = list(ids[0])
+        first_sep = row.index(VOCAB["[SEP]"])
+        assert segs[0, first_sep] == 0 and segs[0, first_sep + 1] == 1
+        assert ids[0, -1] == VOCAB["[PAD]"] or segs[0, -1] in (0, 1)
+
+    def test_truncation_fits_budget(self):
+        tok = make()
+        ids, _ = tok(["hello world " * 50], max_seq_len=16)
+        assert np.asarray(ids._data).shape == (1, 16)
+
+    def test_sparse_vocab_falls_back_to_python(self):
+        sparse = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3, "hello": 10}
+        tok = FasterTokenizer(sparse)
+        assert not tok.is_native  # native loader is line-number-indexed
+        ids, _ = tok(["hello"], max_seq_len=4)
+        assert list(np.asarray(ids._data)[0]) == [2, 10, 3, 0]
+
+    def test_max_seq_len_too_small_raises(self):
+        tok = make()
+        with pytest.raises(ValueError, match="max_seq_len"):
+            tok(["hi"], text_pair=["yo"], max_seq_len=2)
+
+    def test_single_string_and_no_pad(self):
+        tok = make()
+        ids, segs = tok("hello world", max_seq_len=32, pad_to_max_seq_len=False)
+        row = list(np.asarray(ids._data)[0])
+        assert row == [VOCAB["[CLS]"], VOCAB["hello"], VOCAB["world"], VOCAB["[SEP]"]]
