@@ -99,3 +99,40 @@ class TestNativeParity:
         ids, segs = tok("hello world", max_seq_len=32, pad_to_max_seq_len=False)
         row = list(np.asarray(ids._data)[0])
         assert row == [VOCAB["[CLS]"], VOCAB["hello"], VOCAB["world"], VOCAB["[SEP]"]]
+
+
+class TestTokenizerToErnieServing:
+    def test_text_to_prediction_pipeline(self, tmp_path):
+        """The reference's faster_tokenizer_op exists to feed text into
+        BERT/ERNIE serving graphs; drive that pipeline: raw strings →
+        FasterTokenizer → AOT-saved ErnieModel → logits, with save/load
+        output parity."""
+        from paddle_tpu.models.ernie import ErnieConfig, ErnieModel
+        from paddle_tpu.static import InputSpec
+
+        vocab = {t: i for i, t in enumerate(
+            ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello", "world", "good"])}
+        tok = FasterTokenizer(vocab)
+        paddle.seed(0)
+        cfg = ErnieConfig(vocab_size=len(vocab), hidden_size=32, num_layers=2,
+                          num_heads=2, max_position_embeddings=16,
+                          type_vocab_size=2)
+        model = ErnieModel(cfg)
+        model.eval()
+
+        ids, segs = tok(["hello world", "good good"], max_seq_len=8)
+        out = model(ids, token_type_ids=segs)
+        seq_out = out[0] if isinstance(out, (tuple, list)) else out
+        assert np.asarray(seq_out._data).shape[0] == 2
+
+        prefix = str(tmp_path / "ernie")
+        paddle.jit.save(
+            model, prefix,
+            input_spec=[InputSpec([2, 8], "int64", name="input_ids"),
+                        InputSpec([2, 8], "int64", name="token_type_ids")])
+        loaded = paddle.jit.load(prefix)
+        out2 = loaded(ids, segs)
+        a = out[0] if isinstance(out, (tuple, list)) else out
+        b = out2[0] if isinstance(out2, (tuple, list)) else out2
+        np.testing.assert_allclose(
+            np.asarray(a._data), np.asarray(b._data), atol=1e-4)
