@@ -31,3 +31,4 @@ from .custom_op import register_custom_op, get_custom_op, registered_custom_ops 
 from .. import sparse  # noqa: F401 (paddle.incubate.sparse, the v2.3 namespace)
 from ..ops.extra import segment_sum, segment_mean, segment_max, segment_min  # noqa: F401
 from .distributed_fused_lamb import DistributedFusedLamb  # noqa: F401
+from .optimizer_extras import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,162 @@
+"""Incubate optimizers: LookAhead and ModelAverage.
+
+Reference: ``python/paddle/incubate/optimizer/lookahead.py`` (Zhang et al.
+2019 — fast weights advance k steps, slow weights interpolate toward them)
+and ``modelaverage.py`` (evaluation-time parameter averaging over a sliding
+window, with apply()/restore() swap). Both wrap any inner optimizer and keep
+their statistics as device arrays, so the k-step interpolation and the
+running sums stay on-chip.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.engine import no_grad
+from ..core.lazy import concrete as _concrete
+from ..core.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """lookahead.py:35 — ``slow += alpha * (fast - slow)`` every k steps,
+    then fast weights reset to the slow weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= float(alpha) <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if int(k) < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._parameter_list = list(getattr(inner_optimizer, "_parameter_list", []))
+        self._slow = {}  # param name -> slow weight array
+        self._step_count = 0
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, lr):
+        self.inner_optimizer.set_lr(lr)
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k:
+            return
+        for p in self._parameter_list:
+            fast = p._data
+            slow = self._slow.get(p.name)
+            if slow is None:
+                # the slow copy starts from the INITIAL weights: seed it from
+                # the pre-update value is unavailable here, so first sync
+                # adopts the current fast weights (reference seeds at build)
+                slow = fast
+            # explicit dtype: a bare python float promotes to f64 under the
+            # framework's x64 mode when it passes through the lazy recorder
+            alpha = jnp.asarray(self.alpha, dtype=fast.dtype)
+            slow = slow + alpha * (fast - slow)
+            self._slow[p.name] = slow
+            p._set_data(slow)
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        out = {f"slow@{k}": Tensor(_concrete(v)) for k, v in self._slow.items()}
+        out["@lookahead_step"] = self._step_count
+        out["inner"] = self.inner_optimizer.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("@lookahead_step", 0))
+        slow = {
+            k[len("slow@"):]: jnp.asarray(v._data if isinstance(v, Tensor) else v)
+            for k, v in state.items() if isinstance(k, str) and k.startswith("slow@")
+        }
+        # a key matching no parameter would silently restart interpolation
+        # from scratch — fail loudly instead (same contract as DGC)
+        names = {p.name for p in self._parameter_list}
+        stale = set(slow) - names
+        if stale:
+            raise ValueError(
+                f"LookAhead slow-weight keys {sorted(stale)} match no "
+                f"parameter of this optimizer (have {sorted(names)})")
+        self._slow = slow
+        if "inner" in state:
+            self.inner_optimizer.set_state_dict(state["inner"])
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        # base Optimizer.minimize contract (optimizer/__init__.py:202)
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class ModelAverage:
+    """modelaverage.py:33 — running parameter sums over a sliding window;
+    ``apply()`` swaps averaged weights in for evaluation, ``restore()``
+    swaps the training weights back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000, name=None):
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._parameter_list = list(parameters) if parameters is not None else []
+        # per-param: (sum, num) with periodic fold-down like the reference's
+        # sum_1/sum_2/sum_3 cascade (bounded window without storing history);
+        # _num is FLOAT so fold halving keeps sum and divisor consistent
+        self._sum = {}
+        self._num = 0.0
+        self._backup = None
+
+    @no_grad()
+    def step(self):
+        """Accumulate the current weights (call after optimizer.step())."""
+        self._num += 1.0
+        window = max(self.min_w, min(self.max_w, int(self._num * self.rate) or 1))
+        for p in self._parameter_list:
+            cur = jnp.asarray(_concrete(p._data)).astype(jnp.float32)  # f32 accumulation (flush pending lazy)
+            s = self._sum.get(p.name)
+            self._sum[p.name] = cur if s is None else s + cur
+        if self._num > window:
+            # fold: halve the window's weight so old samples decay (the
+            # reference restarts its sum_1 cascade the same bounded way)
+            for k in self._sum:
+                self._sum[k] = self._sum[k] * jnp.float32(0.5)
+            self._num = self._num * 0.5  # same factor as the sums
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        if self._num == 0:
+            return
+        self._backup = {p.name: p._data for p in self._parameter_list}
+        for p in self._parameter_list:
+            avg = self._sum[p.name] / jnp.float32(self._num)
+            p._set_data(avg.astype(p._data.dtype))
+        if not need_restore:
+            self._backup = None
+
+    @no_grad()
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._parameter_list:
+            p._set_data(self._backup[p.name])
+        self._backup = None
+
+    def state_dict(self):
+        out = {f"sum@{k}": Tensor(_concrete(v)) for k, v in self._sum.items()}
+        out["@ma_num"] = self._num
+        return out
+
+    def set_state_dict(self, state):
+        self._num = float(state.get("@ma_num", 0.0))
+        self._sum = {
+            k[len("sum@"):]: jnp.asarray(v._data if isinstance(v, Tensor) else v)
+            for k, v in state.items() if isinstance(k, str) and k.startswith("sum@")
+        }

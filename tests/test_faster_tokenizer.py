@@ -132,7 +132,6 @@ class TestTokenizerToErnieServing:
                         InputSpec([2, 8], "int64", name="token_type_ids")])
         loaded = paddle.jit.load(prefix)
         out2 = loaded(ids, segs)
-        a = out[0] if isinstance(out, (tuple, list)) else out
         b = out2[0] if isinstance(out2, (tuple, list)) else out2
         np.testing.assert_allclose(
-            np.asarray(a._data), np.asarray(b._data), atol=1e-4)
+            np.asarray(seq_out._data), np.asarray(b._data), atol=1e-4)

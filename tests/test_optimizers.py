@@ -250,3 +250,82 @@ class TestDistributedFusedLamb:
         a = train(m2, o2, steps=2)
         b = train(m4, o4, steps=2)
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+class TestIncubateOptimizerExtras:
+    def _fit_problem(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 6).astype(np.float32)
+        Y = X @ rng.randn(6, 1).astype(np.float32)
+        return X, Y
+
+    def test_lookahead_interpolates_every_k(self):
+        from paddle_tpu.incubate import LookAhead
+
+        X, Y = self._fit_problem()
+        paddle.seed(1)
+        m = nn.Linear(6, 1)
+        inner = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+        la = LookAhead(inner, alpha=0.5, k=3)
+        losses = []
+        for _ in range(9):
+            loss = ((m(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+            losses.append(float(loss.item()))
+        assert losses[-1] < losses[0]
+        assert la._slow  # slow weights materialized at the k-step syncs
+        # state roundtrip
+        sd = la.state_dict()
+        la2 = LookAhead(paddle.optimizer.SGD(learning_rate=0.05,
+                                             parameters=m.parameters()),
+                        alpha=0.5, k=3)
+        la2.set_state_dict(sd)
+        assert la2._step_count == la._step_count
+        for k, v in la._slow.items():  # slow weights actually roundtrip
+            np.testing.assert_allclose(np.asarray(la2._slow[k]), np.asarray(v))
+        # mismatched param names must fail loudly, not silently reset
+        m3 = nn.Linear(6, 1)
+        la3 = LookAhead(paddle.optimizer.SGD(learning_rate=0.05,
+                                             parameters=m3.parameters()))
+        with pytest.raises(ValueError, match="slow-weight keys"):
+            la3.set_state_dict(sd)
+
+    def test_model_average_apply_restore(self):
+        from paddle_tpu.incubate import ModelAverage
+
+        X, Y = self._fit_problem()
+        paddle.seed(2)
+        m = nn.Linear(6, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        ma = ModelAverage(0.15, parameters=m.parameters(),
+                          min_average_window=2, max_average_window=10)
+        for _ in range(6):
+            loss = ((m(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.step()
+        w_train = np.asarray(m.weight._data).copy()
+        ma.apply()
+        w_avg = np.asarray(m.weight._data).copy()
+        assert not np.allclose(w_train, w_avg)  # averaged differs from last
+        ma.restore()
+        np.testing.assert_allclose(np.asarray(m.weight._data), w_train)
+
+    def test_model_average_constant_weights_unbiased(self):
+        """Fold-down must keep sum and divisor consistent: averaging a
+        CONSTANT weight must return exactly that weight through folds."""
+        from paddle_tpu.incubate import ModelAverage
+
+        paddle.seed(3)
+        m = nn.Linear(4, 1)
+        w = np.asarray(m.weight._data).copy()
+        ma = ModelAverage(0.15, parameters=m.parameters(),
+                          min_average_window=2, max_average_window=4)
+        for _ in range(7):  # crosses several folds, incl. odd counts
+            ma.step()
+        ma.apply()
+        np.testing.assert_allclose(np.asarray(m.weight._data), w, rtol=1e-6)
+        ma.restore()
