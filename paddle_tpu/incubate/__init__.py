@@ -32,3 +32,7 @@ from .. import sparse  # noqa: F401 (paddle.incubate.sparse, the v2.3 namespace)
 from ..ops.extra import segment_sum, segment_mean, segment_max, segment_min  # noqa: F401
 from .distributed_fused_lamb import DistributedFusedLamb  # noqa: F401
 from .optimizer_extras import LookAhead, ModelAverage  # noqa: F401
+from .operators import (  # noqa: F401
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle, graph_send_recv,
+    graph_khop_sampler, ResNetUnit,
+)

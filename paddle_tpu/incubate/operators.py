@@ -1,0 +1,144 @@
+"""paddle.incubate.operators (reference python/paddle/incubate/operators/).
+
+- ``softmax_mask_fuse`` / ``softmax_mask_fuse_upper_triangle``: the fused
+  CUDA kernels' role is filled by the yaml-generated ops (XLA fuses the
+  mask+softmax into one pass on TPU).
+- ``graph_send_recv``: message passing as gather + segment reduction —
+  jit-safe, static output size.
+- ``graph_khop_sampler``: neighborhood sampling is host-side index work
+  (dynamic shapes), like the reference's CPU kernel.
+- ``ResNetUnit``: the fused conv+bn(+add)+relu block as a layer; on TPU the
+  fusion itself is XLA's (conv epilogues), the class provides the API.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import as_tensor, eager_call
+from ..core.tensor import Tensor
+from ..ops.generated import GENERATED
+
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "graph_send_recv", "graph_khop_sampler", "ResNetUnit"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused pass (fused_softmax_mask_op.cu role)."""
+    return GENERATED["fused_softmax_mask"](x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (fused_softmax_mask_upper_triangle_op.cu role)."""
+    return GENERATED["fused_softmax_mask_upper_triangle"](x)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Gather rows at ``src_index``, reduce them onto ``dst_index``
+    (graph_send_recv_op.cc). pool_type: sum | mean | max | min."""
+    pt = pool_type.lower()
+    if pt not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"pool_type must be sum|mean|max|min, got {pool_type}")
+    x, src_index, dst_index = as_tensor(x), as_tensor(src_index), as_tensor(dst_index)
+    n_out = int(out_size) if out_size is not None else int(x._data.shape[0])
+
+    def fn(xv, si, di, pt, n_out):
+        msgs = xv[si]
+        seg = {"sum": jax.ops.segment_sum, "mean": jax.ops.segment_sum,
+               "max": jax.ops.segment_max, "min": jax.ops.segment_min}[pt]
+        out = seg(msgs, di, num_segments=n_out)
+        if pt == "mean":
+            cnt = jax.ops.segment_sum(jnp.ones_like(di, xv.dtype), di,
+                                      num_segments=n_out)
+            out = out / jnp.maximum(cnt, 1)[(...,) + (None,) * (xv.ndim - 1)]
+        if pt in ("max", "min"):
+            # untouched destinations hold +-inf sentinels: zero them like the
+            # reference (empty receive -> 0)
+            touched = jax.ops.segment_sum(jnp.ones_like(di, jnp.float32), di,
+                                          num_segments=n_out) > 0
+            out = jnp.where(touched[(...,) + (None,) * (xv.ndim - 1)], out, 0)
+        return out
+
+    return eager_call("graph_send_recv", fn, [x, src_index, dst_index],
+                      {"pt": pt, "n_out": n_out})
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       return_eids=False, name=None):
+    """K-hop neighbor sampling over a CSC graph (graph_khop_sampler_op.cc).
+    Host-side (dynamic output shapes, like the reference CPU kernel).
+    Returns (edge_src, edge_dst, sample_index, reindex_nodes)."""
+    if return_eids:
+        raise NotImplementedError(
+            "return_eids=True is not supported by this build's sampler")
+    row_v = np.asarray(as_tensor(row)._data)
+    colptr_v = np.asarray(as_tensor(colptr)._data)
+    seeds = np.asarray(as_tensor(input_nodes)._data).reshape(-1)
+    # fresh randomness per call (stochastic neighborhoods, like the
+    # reference op), seeded from the framework RNG stream
+    from ..core import random as random_state
+
+    rng = np.random.RandomState(
+        int(np.asarray(random_state.next_key())[-1]) % (2 ** 31))
+    cur = seeds
+    all_src, all_dst = [], []
+    for k in sample_sizes:
+        nxt_src, nxt_dst = [], []
+        for v in cur:
+            beg, end = int(colptr_v[v]), int(colptr_v[v + 1])
+            neigh = row_v[beg:end]
+            if len(neigh) > k:
+                neigh = rng.choice(neigh, size=k, replace=False)
+            nxt_src.extend(int(u) for u in neigh)
+            nxt_dst.extend(int(v) for _ in range(len(neigh)))
+        all_src.extend(nxt_src)
+        all_dst.extend(nxt_dst)
+        cur = np.unique(np.asarray(nxt_src, np.int64)) if nxt_src else np.empty(0, np.int64)
+    src = np.asarray(all_src, np.int64)
+    dst = np.asarray(all_dst, np.int64)
+    uniq = np.unique(np.concatenate([seeds, src, dst])) if src.size else seeds
+    remap = {int(g): i for i, g in enumerate(uniq)}
+    r_src = np.asarray([remap[int(u)] for u in src], np.int64)
+    r_dst = np.asarray([remap[int(u)] for u in dst], np.int64)
+    sample_index = Tensor(uniq)
+    return Tensor(r_src), Tensor(r_dst), sample_index, Tensor(
+        np.asarray([remap[int(s)] for s in seeds], np.int64))
+
+
+class ResNetUnit(nn.Layer):
+    """Fused conv+BN(+residual add)+ReLU block (resnet_unit.py / the
+    cuDNN-fused resnet_unit op). On TPU the fusion is XLA's conv-epilogue
+    job; this class carries the API (optionally a second conv+BN branch on
+    the shortcut, like the reference's has_shortcut mode)."""
+
+    def __init__(self, num_channels_x, num_filters, filter_size, stride=1,
+                 momentum=0.9, eps=1e-5, data_format="NCHW", act="relu",
+                 has_shortcut=False, num_channels_z=None, **kw):
+        super().__init__()
+        if data_format != "NCHW":
+            raise NotImplementedError("ResNetUnit supports NCHW here")
+        if act not in ("relu", None, ""):
+            raise ValueError(f"unsupported act {act!r}; this unit fuses 'relu'")
+        pad = (filter_size - 1) // 2
+        self.conv = nn.Conv2D(num_channels_x, num_filters, filter_size,
+                              stride=stride, padding=pad, bias_attr=False)
+        self.bn = nn.BatchNorm2D(num_filters, momentum=momentum, epsilon=eps)
+        self.has_shortcut = bool(has_shortcut)
+        if self.has_shortcut:
+            self.conv_z = nn.Conv2D(num_channels_z or num_channels_x,
+                                    num_filters, 1, stride=stride,
+                                    bias_attr=False)
+            self.bn_z = nn.BatchNorm2D(num_filters, momentum=momentum,
+                                       epsilon=eps)
+        self.act = act
+
+    def forward(self, x, z=None):
+        out = self.bn(self.conv(x))
+        if z is not None:
+            out = out + (self.bn_z(self.conv_z(z)) if self.has_shortcut else z)
+        if self.act == "relu":
+            out = nn.functional.relu(out)
+        return out

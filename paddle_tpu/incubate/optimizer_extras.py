@@ -134,6 +134,10 @@ class ModelAverage:
     def apply(self, executor=None, need_restore=True):
         if self._num == 0:
             return
+        if self._backup is not None:
+            raise RuntimeError(
+                "ModelAverage.apply() called twice without restore(): the "
+                "training weights would be overwritten by averaged ones")
         self._backup = {p.name: p._data for p in self._parameter_list}
         for p in self._parameter_list:
             avg = self._sum[p.name] / jnp.float32(self._num)
@@ -156,7 +160,14 @@ class ModelAverage:
 
     def set_state_dict(self, state):
         self._num = float(state.get("@ma_num", 0.0))
-        self._sum = {
+        sums = {
             k[len("sum@"):]: jnp.asarray(v._data if isinstance(v, Tensor) else v)
             for k, v in state.items() if isinstance(k, str) and k.startswith("sum@")
         }
+        names = {p.name for p in self._parameter_list}
+        stale = set(sums) - names
+        if stale:
+            raise ValueError(
+                f"ModelAverage sum keys {sorted(stale)} match no parameter "
+                f"of this optimizer (have {sorted(names)})")
+        self._sum = sums

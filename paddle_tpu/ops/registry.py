@@ -78,6 +78,16 @@ def all_ops() -> Dict[str, Callable]:
     ops.update({
         f"metric.{n}": getattr(metrics_ops, n) for n in metrics_ops.__all__
     })
+    try:
+        from ..incubate import operators as incubate_ops
+
+        ops.update({
+            f"incubate.{n}": getattr(incubate_ops, n)
+            for n in ("softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+                      "graph_send_recv", "graph_khop_sampler")
+        })
+    except ImportError:
+        pass
     ops.update(inplace.INPLACE_OPS)
     return ops
 

@@ -1,0 +1,77 @@
+"""paddle.incubate.operators (reference incubate/operators/): graph message
+passing, k-hop sampling, fused-softmax aliases, ResNetUnit."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import (
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle, graph_send_recv,
+    graph_khop_sampler, ResNetUnit,
+)
+
+
+class TestGraphSendRecv:
+    def test_sum_matches_loop(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        src, dst = [0, 1, 2, 0], [1, 2, 1, 0]
+        out = np.asarray(graph_send_recv(
+            x, paddle.to_tensor(np.array(src)), paddle.to_tensor(np.array(dst)), "sum")._data)
+        want = np.zeros((4, 3), np.float32)
+        xv = np.arange(12, dtype=np.float32).reshape(4, 3)
+        for s, d in zip(src, dst):
+            want[d] += xv[s]
+        np.testing.assert_allclose(out, want)
+
+    def test_mean_and_untouched_max(self):
+        x = paddle.to_tensor(np.ones((3, 2), np.float32))
+        src = paddle.to_tensor(np.array([0, 1]))
+        dst = paddle.to_tensor(np.array([0, 0]))
+        mean = np.asarray(graph_send_recv(x, src, dst, "mean")._data)
+        np.testing.assert_allclose(mean[0], 1.0)
+        mx = np.asarray(graph_send_recv(x, src, dst, "max")._data)
+        assert mx[2].sum() == 0  # empty receive -> 0, not -inf
+
+    def test_grad_flows(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+        x.stop_gradient = False
+        out = graph_send_recv(x, paddle.to_tensor(np.array([0, 1])),
+                              paddle.to_tensor(np.array([1, 1])), "sum")
+        out.sum().backward()
+        g = np.asarray(x.grad._data)
+        assert g[0].sum() == 3.0 and g[2].sum() == 0.0
+
+
+class TestKhopSampler:
+    def test_samples_bounded_neighborhood(self):
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 5, 6]))
+        row = paddle.to_tensor(np.array([1, 2, 0, 0, 3, 2]))
+        es, ed, samp, re = graph_khop_sampler(
+            row, colptr, paddle.to_tensor(np.array([0])), [2])
+        es, ed = np.asarray(es._data), np.asarray(ed._data)
+        assert len(es) == 2 and len(ed) == 2
+        uniq = np.asarray(samp._data)
+        assert 0 in uniq  # seeds always present
+        assert es.max() < len(uniq) and ed.max() < len(uniq)  # reindexed
+
+
+class TestFusedSoftmaxAliases:
+    def test_mask_fuse(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        out = np.asarray(softmax_mask_fuse(
+            x, paddle.to_tensor(np.zeros((2, 4), np.float32)))._data)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_upper_triangle(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 4).astype(np.float32))
+        out = np.asarray(softmax_mask_fuse_upper_triangle(x)._data)
+        assert abs(out[0, 0] - 1.0) < 1e-5  # row 0 attends only position 0
+        assert out[0, 1:].max() < 1e-6
+
+
+class TestResNetUnit:
+    def test_forward_and_shortcut(self):
+        paddle.seed(0)
+        u = ResNetUnit(3, 8, 3, stride=2, has_shortcut=True, num_channels_z=3)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32))
+        y = u(x, z=x)
+        assert tuple(y.shape) == (2, 8, 4, 4)
+        assert float(np.asarray(y._data).min()) >= 0.0
