@@ -131,6 +131,10 @@ from .hapi.model import Model, summary  # noqa: F401
 from .hapi.flops import flops  # noqa: F401
 from . import onnx  # noqa: F401
 from . import hub  # noqa: F401
+from . import reader  # noqa: F401  (v1 reader decorators)
+from . import dataset  # noqa: F401  (v1 generator datasets)
+from . import tensor  # noqa: F401  (paddle.tensor namespace)
+from . import cost_model  # noqa: F401
 from . import distribution  # noqa: F401
 
 from .io import DataLoader  # noqa: F401

@@ -1,0 +1,108 @@
+"""paddle.cost_model (reference python/paddle/cost_model/cost_model.py):
+per-op and whole-program cost estimation.
+
+The reference ships a measured static table (static_op_benchmark.json) plus
+a profiler-measured mode. TPU-first: costs come from XLA itself —
+``jit(...).lower().compile().cost_analysis()`` gives flops/bytes per
+compiled program, and per-op timings are measured on the live backend, so
+the numbers track the REAL compiler and chip instead of a frozen table.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def __init__(self):
+        self._static_cache: Dict[tuple, dict] = {}
+
+    # -- whole-program analysis (reference profile_measure) ------------------
+    def profile_measure(self, program=None, startup_program=None,
+                        device="tpu", fetch_cost_list=("time",), fn=None,
+                        args=None, iters=10):
+        """Measure a compiled program. Either pass a ``static.Program``-backed
+        callable via ``fn``/``args`` or a traced Program with a runner.
+        Returns {"time": ms_per_iter, "flops": ..., "bytes": ...}."""
+        import jax
+
+        if fn is None and program is not None and hasattr(program, "_fn"):
+            fn, args = program._fn, program._example_args
+        if fn is None:
+            raise ValueError("pass fn=<jittable callable>, args=<inputs>")
+        jitted = jax.jit(fn)
+        out = jitted(*args)
+        jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+        t0 = time.time()
+        for _ in range(iters):
+            out = jitted(*args)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(leaf).ravel()[:1]  # host fetch = hard sync
+        dt = (time.time() - t0) / iters
+        cost = {}
+        try:
+            analysis = jitted.lower(*args).compile().cost_analysis()
+            if isinstance(analysis, (list, tuple)):
+                analysis = analysis[0]
+            cost["flops"] = float(analysis.get("flops", 0.0))
+            cost["bytes"] = float(analysis.get("bytes accessed", 0.0))
+        except Exception:
+            pass
+        cost["time"] = dt * 1e3  # ms, reference units
+        return cost
+
+    # -- per-op costs (reference static_cost_data/get_static_op_time) --------
+    def static_cost_data(self):
+        """The measured per-op table built so far (op → cost dict)."""
+        return {f"{k[0]}/{k[1]}/{k[2]}/{k[3]}": v
+                for k, v in self._static_cache.items()}
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32",
+                           shape=(1024, 1024)):
+        """Measure (and cache) one op's time on the live backend — the role
+        of the reference's frozen static_op_benchmark.json, but tracking the
+        real compiler/chip. Returns {"op_time": ms, "flops": ...}."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.registry import all_ops
+
+        key = (op_name, bool(forward), str(dtype), tuple(shape))
+        if key in self._static_cache:
+            return self._static_cache[key]
+        ops = all_ops()
+        op = ops.get(op_name) or ops.get(f"functional.{op_name}")
+        if op is None:
+            raise KeyError(f"unknown op {op_name!r} (registry has {len(ops)})")
+        rng = np.random.RandomState(0)
+        x = rng.rand(*shape).astype(dtype) + 0.5
+
+        import paddle_tpu as paddle
+
+        xt = paddle.to_tensor(x)
+        if forward:
+            def run():
+                return op(xt)
+        else:
+            xt.stop_gradient = False
+
+            def run():
+                out = op(xt)
+                out = out[0] if isinstance(out, (tuple, list)) else out
+                out.sum().backward()
+                g = xt.grad
+                xt.clear_grad()
+                return g
+        out = run()
+        t0 = time.time()
+        for _ in range(5):
+            out = run()
+        o = out[0] if isinstance(out, (tuple, list)) else out
+        float(np.asarray(o.numpy()).ravel()[0])
+        cost = {"op_time": (time.time() - t0) / 5 * 1e3, "dtype": str(dtype)}
+        self._static_cache[key] = cost
+        return cost

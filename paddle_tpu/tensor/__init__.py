@@ -1,0 +1,24 @@
+"""paddle.tensor — the tensor-function namespace (reference
+python/paddle/tensor/): the same op surface that is attached to
+``paddle.*`` and as Tensor methods, re-exported under the module paths v1
+code imports from (paddle.tensor.math / creation / manipulation / linalg /
+search / logic / random / attribute / stat)."""
+from ..ops import creation, linalg, manipulation, math, misc  # noqa: F401
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.linalg import *  # noqa: F401,F403
+
+# reference sub-module aliases (paddle.tensor.math.add etc.)
+import types as _types
+
+random = creation
+attribute = math
+stat = math
+logic = math
+# search spans both modules in the reference (argmax/argmin live with math
+# here; sort/searchsorted with manipulation) — expose the union
+search = _types.SimpleNamespace(
+    **{n: getattr(manipulation, n) for n in dir(manipulation) if not n.startswith("_")},
+    **{n: getattr(math, n) for n in ("argmax", "argmin") if hasattr(math, n)},
+)

@@ -1,0 +1,110 @@
+"""v1 compatibility namespaces: paddle.reader, paddle.dataset,
+paddle.tensor, paddle.cost_model (reference python/paddle/{reader,dataset,
+tensor,cost_model}/)."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class TestReaderDecorators:
+    def test_cache_replays(self):
+        from paddle_tpu import reader
+
+        calls = []
+
+        def creator():
+            calls.append(1)
+            return iter(range(4))
+
+        cached = reader.cache(creator)
+        assert list(cached()) == [0, 1, 2, 3]
+        assert list(cached()) == [0, 1, 2, 3]
+        assert len(calls) == 1
+
+    def test_shuffle_chain_compose_firstn(self):
+        from paddle_tpu import reader
+
+        assert sorted(reader.shuffle(lambda: iter(range(10)), 4)()) == list(range(10))
+        assert list(reader.chain(lambda: iter([1]), lambda: iter([2, 3]))()) == [1, 2, 3]
+        out = list(reader.compose(lambda: iter([1, 2]),
+                                  lambda: iter([(3, 4), (5, 6)]))())
+        assert out == [(1, 3, 4), (2, 5, 6)]
+        assert list(reader.firstn(lambda: iter(range(100)), 2)()) == [0, 1]
+
+    def test_compose_misaligned_raises(self):
+        from paddle_tpu import reader
+
+        import pytest
+        with pytest.raises(reader.ComposeNotAligned):
+            list(reader.compose(lambda: iter([1]), lambda: iter([1, 2]))())
+
+    def test_xmap_ordered(self):
+        from paddle_tpu import reader
+
+        out = list(reader.xmap_readers(lambda x: x * x, lambda: iter(range(9)),
+                                       3, 4, order=True)())
+        assert out == [i * i for i in range(9)]
+
+    def test_map_readers_and_buffered(self):
+        from paddle_tpu import reader
+
+        m = reader.map_readers(lambda a, b: a + b,
+                               lambda: iter([1, 2]), lambda: iter([10, 20]))
+        assert list(m()) == [11, 22]
+        assert list(reader.buffered(lambda: iter(range(6)), 2)()) == list(range(6))
+
+
+class TestDatasetNamespace:
+    def test_mnist_generator(self):
+        from paddle_tpu import dataset
+
+        sample = next(iter(dataset.mnist.train()()))
+        img, label = sample
+        assert img.shape == (784,)
+        assert 0 <= int(label) < 10
+
+    def test_text_generators(self):
+        from paddle_tpu import dataset
+
+        row = next(iter(dataset.uci_housing.train()()))
+        assert len(row) == 2
+        first = next(iter(dataset.imikolov.train()()))
+        assert first is not None
+
+    def test_download_refuses_egress(self):
+        from paddle_tpu.dataset import common
+
+        import pytest
+        with pytest.raises(RuntimeError, match="egress"):
+            common.download("http://x", "m", "0")
+
+
+class TestTensorNamespace:
+    def test_functions_reachable(self):
+        import paddle_tpu.tensor as T
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        assert float(T.sum(x).item()) == 3.0
+        assert float(T.add(x, x).numpy()[1]) == 4.0
+        assert T.math is not None and T.creation is not None
+
+
+class TestCostModel:
+    def test_profile_measure_and_op_time(self):
+        import jax.numpy as jnp
+        from paddle_tpu.cost_model import CostModel
+
+        cm = CostModel()
+        c = cm.profile_measure(
+            fn=lambda a, b: (a @ b).sum(),
+            args=(jnp.ones((64, 64), jnp.float32), jnp.ones((64, 64), jnp.float32)),
+            iters=3)
+        assert c["time"] > 0
+        assert c.get("flops", 1) > 0
+        t = cm.get_static_op_time("relu", shape=(32, 32))
+        assert t["op_time"] > 0
+        assert len(cm.static_cost_data()) == 1
+        # cache hit returns the same record
+        assert cm.get_static_op_time("relu", shape=(32, 32)) is t
