@@ -31,7 +31,9 @@ def bench_gpt(paddle, jax, np, on_tpu):
             vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
             max_position_embeddings=1024, hidden_dropout=0.0, attention_dropout=0.0,
             # unfused CE is ~6% faster at b8 (fits comfortably); the fused
-            # path exists for memory-bound configs (1.3B, 8k below).
+            # path exists for memory-bound configs (1.3B below). Round-5
+            # block sweep re-confirmed: fused loses at every block_rows
+            # (4096: 43.9k, 8192: 43.6k vs 45.1k unfused, same session).
             # Round-4 optimization search (interleaved in-process A/B, hard
             # syncs): flash-vs-exact attention ±0.1%, fused CE −5%, b16/b32
             # batches −5..−50% (exact attn collapses at b16+; flash holds),
