@@ -135,6 +135,9 @@ from . import reader  # noqa: F401  (v1 reader decorators)
 from . import dataset  # noqa: F401  (v1 generator datasets)
 from . import tensor  # noqa: F401  (paddle.tensor namespace)
 from . import cost_model  # noqa: F401
+from . import callbacks  # noqa: F401
+from .batch import batch  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
 from . import distribution  # noqa: F401
 
 from .io import DataLoader  # noqa: F401

@@ -1,0 +1,6 @@
+"""paddle.callbacks — hapi training callbacks at the reference's top-level
+path (python/paddle/callbacks.py re-exports hapi/callbacks.py)."""
+from .hapi.callbacks import *  # noqa: F401,F403
+from .hapi import callbacks as _cb
+
+__all__ = getattr(_cb, "__all__", [n for n in dir(_cb) if not n.startswith("_")])

@@ -5,6 +5,9 @@ import os
 import time
 
 
+__all__ = ['Callback', 'CallbackList', 'ProgBarLogger', 'ModelCheckpoint', 'EarlyStopping', 'LRScheduler', 'config_callbacks', 'ReduceLROnPlateau', 'VisualDL']
+
+
 class Callback:
     def __init__(self):
         self.model = None
