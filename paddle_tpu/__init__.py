@@ -138,6 +138,32 @@ from . import cost_model  # noqa: F401
 from . import callbacks  # noqa: F401
 from .batch import batch  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
+from .nn import ParamAttr  # noqa: F401
+from .core.place import (  # noqa: F401
+    CUDAPinnedPlace, IPUPlace, MLUPlace, NPUPlace, XPUPlace, CustomPlace,
+)
+from .core.engine import grad_enabled as is_grad_enabled  # noqa: F401
+from .ops.math import floor_mod  # noqa: F401
+from .ops.inplace import INPLACE_OPS as _INPLACE_OPS
+
+# v1 top-level in-place names (paddle.tanh_ etc.)
+for _n in ("scatter_", "squeeze_", "tanh_", "unsqueeze_", "relu_", "clip_",
+           "exp_", "sqrt_", "subtract_", "add_"):
+    if _n in _INPLACE_OPS:
+        globals()[_n] = _INPLACE_OPS[_n]
+del _n
+
+# paddle.dtype — the dtype TYPE for isinstance checks (all framework dtypes,
+# including the ml_dtypes bfloat16, are numpy dtype instances)
+import numpy as _np  # noqa: E402
+
+dtype = _np.dtype
+
+
+def get_cudnn_version():
+    """No cuDNN in a TPU-native build (the reference returns a version int
+    on CUDA installs; None means 'not compiled with cuDNN' there too)."""
+    return None
 from . import distribution  # noqa: F401
 
 from .io import DataLoader  # noqa: F401

@@ -107,3 +107,40 @@ def is_compiled_with_cuda() -> bool:
 
 def is_compiled_with_tpu() -> bool:
     return any(d.platform == "tpu" for d in jax.devices())
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """Pinned host memory place (reference platform/place.h). On this
+    runtime host staging is the arena allocator's job; the class exists for
+    API parity and behaves as host memory."""
+
+
+class _UnavailablePlace:
+    """Reference device places with no backing hardware here (IPU/MLU/NPU/
+    XPU/custom). Constructing one fails loudly instead of silently running
+    on the wrong device."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            f"{type(self).__name__} hardware is not available in this "
+            "TPU-native build; use CPUPlace() or TPUPlace()")
+
+
+class IPUPlace(_UnavailablePlace):
+    pass
+
+
+class MLUPlace(_UnavailablePlace):
+    pass
+
+
+class NPUPlace(_UnavailablePlace):
+    pass
+
+
+class XPUPlace(_UnavailablePlace):
+    pass
+
+
+class CustomPlace(_UnavailablePlace):
+    pass

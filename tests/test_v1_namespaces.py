@@ -141,3 +141,19 @@ class TestTopLevelStaples:
             loss.backward(); opt.step(); opt.clear_grad()
             losses.append(float(loss.item()))
         assert len(losses) == 4 and np.isfinite(losses).all()
+
+    def test_places_and_misc_staples(self):
+        assert paddle.CUDAPinnedPlace() is not None
+        import pytest
+        for P in (paddle.NPUPlace, paddle.XPUPlace, paddle.IPUPlace,
+                  paddle.MLUPlace, paddle.CustomPlace):
+            with pytest.raises(RuntimeError, match="not available"):
+                P(0)
+        assert paddle.is_grad_enabled() in (True, False)
+        assert paddle.get_cudnn_version() is None
+        assert float(paddle.floor_mod(paddle.to_tensor(7), paddle.to_tensor(3)).item()) == 1
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        paddle.tanh_(x)
+        np.testing.assert_allclose(x.numpy()[0], np.tanh(2.0), rtol=1e-4)
+        assert isinstance(np.zeros(1).dtype, paddle.dtype)
+        assert paddle.ParamAttr is not None
