@@ -173,4 +173,12 @@ disable_static = lambda *a, **k: None  # eager is the default (reference: paddle
 enable_static = lambda *a, **k: None
 in_dynamic_mode = lambda: True
 
+# Warm executable starts: the lazy-flush signatures (and per-op jit keys) are
+# stable across processes, so XLA's persistent compilation cache turns the
+# first step of a rerun into a disk hit instead of a compile. Off via
+# FLAGS_xla_persistent_cache=0 (see framework/flags.py).
+from .core.compat import enable_persistent_compilation_cache as _enable_pcc  # noqa: E402
+
+_enable_pcc()
+
 __version__ = "0.1.0"

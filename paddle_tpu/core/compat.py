@@ -1,0 +1,116 @@
+"""Cross-version JAX API shims.
+
+The public homes of ``shard_map`` and ``export`` moved between jax releases:
+
+* ``shard_map``: ``jax.experimental.shard_map.shard_map`` (<= 0.4.x, kwarg
+  ``check_rep``) became ``jax.shard_map`` (>= 0.5, kwarg ``check_vma``).
+* ``export``: ``jax.experimental.export`` (<= 0.4.2x) became ``jax.export``
+  (a lazily-imported submodule — plain attribute access on ``jax`` raises
+  AttributeError until something imports it).
+
+Every in-repo and in-test use goes through this module so a jax upgrade is a
+one-file change (SURVEY §4: version-drift collection errors silently dropped
+three files from tier-1).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = [
+    "shard_map", "shard_map_check_kwargs", "jax_export", "axis_size",
+    "enable_persistent_compilation_cache",
+]
+
+try:  # jax >= 0.5: stable API, replication check renamed to check_vma
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+try:  # jax >= 0.5: promoted out of experimental
+    from jax import enable_x64  # type: ignore[attr-defined]  # noqa: F401
+except ImportError:
+    from jax.experimental import enable_x64  # noqa: F401
+
+
+def shard_map(f, *args, **kwargs):
+    """``jax.shard_map`` resolved across versions; accepts either spelling of
+    the replication-check kwarg (``check_vma``/``check_rep``) and translates
+    to whatever this jax understands."""
+    for alias in ("check_vma", "check_rep"):
+        if alias in kwargs and alias != _CHECK_KW:
+            kwargs[_CHECK_KW] = kwargs.pop(alias)
+    return _shard_map(f, *args, **kwargs)
+
+
+def shard_map_check_kwargs(value=False):
+    """Kwargs dict disabling (or enabling) the replication check, spelled for
+    this jax version: ``{"check_vma": value}`` or ``{"check_rep": value}``."""
+    return {_CHECK_KW: value}
+
+
+def axis_size(axis: str) -> int:
+    """Size of a bound manual mesh axis; raises (NameError) when ``axis`` is
+    not bound. ``lax.axis_size`` only exists on newer jax — the classic
+    spelling is ``psum(1, axis)``, which constant-folds to the axis size
+    inside shard_map/pmap and raises outside one."""
+    from jax import lax
+
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    return lax.psum(1, axis)
+
+
+def jax_export():
+    """The export module (``jax.export`` on >= 0.4.30, else
+    ``jax.experimental.export``). Importing it also binds the ``jax.export``
+    attribute, so legacy ``jax.export.deserialize`` call sites work after any
+    paddle_tpu import."""
+    try:
+        import jax.export as m  # submodule import works even when the lazy
+        return m  # attribute on `jax` hasn't been materialized
+    except ImportError:
+        from jax.experimental import export as m
+
+        return m
+
+
+def enable_persistent_compilation_cache():
+    """Point JAX's persistent compilation cache at a paddle_tpu-owned dir so
+    re-runs warm-start compiles (the flush-executable signatures are stable
+    across processes). Controlled by ``FLAGS_xla_persistent_cache`` (default
+    on) and ``FLAGS_xla_persistent_cache_dir``. Returns the dir or None."""
+    from ..framework import flags as _flags
+
+    if not _flags.flag("FLAGS_xla_persistent_cache", True):
+        return None
+    # Respect a cache the host application already configured (env var or
+    # jax.config.update before importing paddle_tpu) — the compilation cache
+    # is process-global and hijacking it would cold-start their workloads.
+    existing = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if existing:
+        return existing
+    d = _flags.flag("FLAGS_xla_persistent_cache_dir") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu", "xla"
+    )
+    try:
+        os.makedirs(d, exist_ok=True)
+        # jax's default threshold (1s) is tuned for serving-sized programs;
+        # a train step's flush executable compiles faster than that on CPU
+        # yet is exactly what a warm restart wants back. Set the threshold
+        # BEFORE the dir: if either option is missing on this jax, nothing
+        # is half-activated (a threshold without a dir is inert).
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(_flags.flag("FLAGS_xla_persistent_cache_min_compile_secs", 0.5)),
+        )
+        jax.config.update("jax_compilation_cache_dir", d)
+        return d
+    except Exception:
+        return None

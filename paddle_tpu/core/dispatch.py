@@ -71,10 +71,44 @@ _JIT_CACHE_MAX = 4096
 
 _fn_key = lazy_mod._fn_key  # one implementation; key includes kw-only defaults
 
+# Per-call-site key memo: ops define their fn at a fixed source location, and
+# for the common closure-free/default-free shape the key is fully determined
+# by the code object — skip re-hashing () cells and defaults on every call.
+# Closures over attr values still hash their cell contents (values vary).
+_code_key_cache: dict = {}
+
+
+def _fast_fn_key(fn):
+    try:
+        if fn.__closure__ is None and not fn.__defaults__ and not fn.__kwdefaults__:
+            code = fn.__code__
+            k = _code_key_cache.get(code)
+            if k is None:
+                k = _fn_key(fn)
+                if len(_code_key_cache) > _JIT_CACHE_MAX:
+                    _code_key_cache.clear()  # exec/notebook-generated code objects
+                _code_key_cache[code] = k
+            elif _profiler is not None and _profiler._enabled:
+                _profiler.counter_inc("dispatch_fastkey_hits")
+            return k
+    except AttributeError:
+        pass
+    return _fn_key(fn)
+
+
+def _attrs_key(attrs):
+    """Hashable signature of an op's attrs; () for the no-attr fast path.
+    Raises TypeError for unhashable attrs (callers fall back)."""
+    if not attrs:
+        return ()
+    key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+    hash(key)
+    return key
+
 
 def _get_jitted(fn, attrs):
     try:
-        key = (_fn_key(fn), tuple(sorted((k, _hashable(v)) for k, v in attrs.items())))
+        key = (_fast_fn_key(fn), _attrs_key(attrs))
         hash(key)
     except TypeError:  # unhashable attr → run eagerly un-jitted
         return lambda *arrays: fn(*arrays, **attrs)
@@ -165,8 +199,7 @@ def _eager_call_impl(
     has_tracer = any(isinstance(a, jax.core.Tracer) for a in arrays)
     if not check_naninf and not has_tracer and lazy_mod.lazy_enabled():
         try:
-            attrs_key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
-            hash(attrs_key)
+            attrs_key = _attrs_key(attrs)
         except TypeError:
             attrs_key = None
         if attrs_key is not None:
@@ -292,7 +325,7 @@ def _lazy_eager_call(
     defers jax.vjp into the graph too (vjp composes under tracing), so a
     whole backward()+optimizer.step()+next-forward chain flushes as ONE
     compiled XLA computation."""
-    key = ((fn_key if fn_key is not None else _fn_key(fn)), attrs_key)
+    key = ((fn_key if fn_key is not None else _fast_fn_key(fn)), attrs_key)
     fwd = lambda *xs: fn(*xs, **attrs)
 
     outs, single = lazy_mod.record(name, fwd, list(arrays), key=key)

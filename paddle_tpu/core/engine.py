@@ -318,9 +318,13 @@ def _tape_backward(roots, grad_tensors, retain_graph):
         if t.grad is None:
             t.grad = Tensor(g_arr, stop_gradient=True)
         else:
+            # accumulation rebinds the grad buffer through the graph: the
+            # displaced buffer is donatable once nothing else references it
+            old = t.grad._data
             t.grad._data = lazy_mod.maybe_lazy_binary(
-                jnp.add, t.grad._data, g_arr, name="grad_acc"
+                jnp.add, old, g_arr, name="grad_acc"
             )
+            lazy_mod.note_rebound(old)
 
     # leaf roots seed directly
     for t, g in zip(roots, grad_tensors):
@@ -334,7 +338,9 @@ def _tape_backward(roots, grad_tensors, retain_graph):
         if t.grad is None:
             t.grad = Tensor(seed, stop_gradient=True)
         else:
-            t.grad._data = lazy_mod.maybe_lazy_binary(jnp.add, t.grad._data, seed, name="grad_acc")
+            old = t.grad._data
+            t.grad._data = lazy_mod.maybe_lazy_binary(jnp.add, old, seed, name="grad_acc")
+            lazy_mod.note_rebound(old)
     return {}
 
 
@@ -566,8 +572,10 @@ def run_backward(
         else:
             from . import lazy as lazy_mod
 
+            old = t.grad._data
             t.grad._data = lazy_mod.maybe_lazy_binary(
-                jnp.add, t.grad._data, g_arr, name="grad_acc"
+                jnp.add, old, g_arr, name="grad_acc"
             )
+            lazy_mod.note_rebound(old)
 
     return captured

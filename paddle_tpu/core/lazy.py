@@ -35,7 +35,9 @@ after ``opt.step()`` (or only every N steps) pay nothing.
 from __future__ import annotations
 
 import collections
+import sys
 import threading
+import warnings
 import weakref
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -46,6 +48,7 @@ import jax.numpy as jnp
 __all__ = [
     "LazyArray", "record", "flush", "lazy_enabled", "set_lazy_mode",
     "lazy_guard", "is_lazy", "maybe_lazy_binary", "lazy_full",
+    "note_rebound",
 ]
 
 _state = threading.local()
@@ -279,6 +282,87 @@ def _graph() -> _Graph:
     return g
 
 
+# -- donation candidates -----------------------------------------------------
+# Buffers whose holder rebound them THROUGH the pending graph (a Tensor's
+# _data replaced by a flush output, an optimizer moment replaced by its
+# update, a grad buffer replaced by its accumulation). These are the
+# dead-after-flush candidates the liveness pass in _flush_impl may pass as
+# donate_argnums. Ids only — holding a reference here would defeat the
+# refcount deadness test that guards against user-held aliases.
+_DONATE_IDS_MAX = 65536
+
+
+def note_rebound(old):
+    """Record that ``old`` (a jax.Array, or a LazyArray wrapping one) was
+    replaced by a pending-graph output in whatever slot held it. No-op when
+    nothing is queued — candidacy only means anything for buffers feeding the
+    pending graph."""
+    g = getattr(_state, "graph", None)
+    if g is None or not g.nodes:
+        return
+    if isinstance(old, LazyArray):
+        old = old._concrete
+    if old is None or not isinstance(old, jax.Array):
+        return
+    s = getattr(_state, "donate_ids", None)
+    if s is None:
+        s = set()
+        _state.donate_ids = s
+    if len(s) < _DONATE_IDS_MAX:
+        s.add(id(old))
+
+
+def _false():
+    return False
+
+
+_donation_warnings_filtered = False
+
+
+def _ignore_donation_warnings():
+    """XLA may decline an aliasing hint (layout/sharding mismatch) and jax
+    warns per unusable donation — correct but noisy once per train step.
+    Installed ONCE: catch_warnings around every flush would copy/restore the
+    process-global filter list on the hot path (and isn't thread-safe).
+    Action "once" (not "ignore"): the filter is process-global and jax emits
+    the SAME text for a user's own jit(donate_argnums=...) — one surviving
+    diagnostic per warn-site keeps their misconfiguration visible while
+    killing the per-step repeat."""
+    global _donation_warnings_filtered
+    if not _donation_warnings_filtered:
+        warnings.filterwarnings(
+            "once", message=r"Some donated buffers were not usable"
+        )
+        _donation_warnings_filtered = True
+
+
+def _donation_mask(leaves, cand, direct_uses, via_lazy):
+    """Leaf positions provably dead after this flush: marked as rebound AND
+    the only strong references left are the pending graph's own input lists.
+    Runs in its own frame so the caller's loop variables can't inflate the
+    refcount of the leaf under test."""
+    out = []
+    for j in range(len(leaves)):
+        x = leaves[j]
+        i = id(x)
+        if (
+            i not in cand
+            or i in via_lazy  # still reachable via a (possibly live) LazyArray
+            or not isinstance(x, jax.Array)
+            or isinstance(x, jax.core.Tracer)
+        ):
+            x = None
+            continue
+        # Refcount at this point for a dead buffer: one per occurrence in a
+        # node's input list, plus the flush `leaves` list, the loop binding
+        # `x`, and getrefcount's own argument. Anything above that is a live
+        # Tensor / user alias / residual capture — donation would corrupt it.
+        if sys.getrefcount(x) == direct_uses.get(i, 0) + 3:
+            out.append(j)
+        x = None
+    return tuple(out)
+
+
 # -- aval probing (cached) ---------------------------------------------------
 _aval_cache: dict = {}
 _AVAL_CACHE_MAX = 8192
@@ -411,14 +495,18 @@ def _flush_impl(g: _Graph):
 
     leaves: list = []
     leaf_pos: dict = {}
+    direct_uses: dict = {}  # id(leaf) -> occurrences in node input lists
+    via_lazy: set = set()  # leaf ids reached through a LazyArray._concrete
     descs_all: list = []
     sig_parts: list = []
     for n in nodes:
         descs = []
         for x in n.inputs:
+            indirect = False
             if isinstance(x, LazyArray):
                 if x._concrete is not None:
                     x = x._concrete
+                    indirect = True
                 else:
                     i = node_index.get(id(x._node))
                     if i is None:
@@ -433,29 +521,52 @@ def _flush_impl(g: _Graph):
                 j = len(leaves)
                 leaf_pos[id(x)] = j
                 leaves.append(x)
+            if indirect:
+                via_lazy.add(id(x))
+            else:
+                direct_uses[id(x)] = direct_uses.get(id(x), 0) + 1
             descs.append(("l", j))
         descs_all.append(tuple(descs))
         alive = tuple(r() is not None for r in n.out_refs)
         sig_parts.append((n.key, tuple(descs), alive))
+    x = n = None  # drop loop bindings: they'd count as refs in the mask pass
+
+    # Liveness pass: donate leaves that were rebound through this graph and
+    # that nothing outside the graph still references. The mask is part of
+    # the executable signature, so a cache hit always replays with the same
+    # donation layout it was compiled with.
+    from ..framework import flags as _flags
+
+    donate_ix: tuple = ()
+    cand = getattr(_state, "donate_ids", None)
+    if cand and _flags.flag("FLAGS_lazy_donate", True):
+        donate_ix = _donation_mask(leaves, cand, direct_uses, via_lazy)
+    if cand:
+        cand.clear()
 
     try:
-        sig = tuple(sig_parts)
+        sig = (tuple(sig_parts), donate_ix)
         hash(sig)
     except TypeError:
         sig = None
 
+    from .dispatch import _prof
+
+    prof = _prof()
+    prof.counter_inc("lazy_flushes")
+
     entry = _flush_cache.get(sig) if sig is not None else None
     if entry is None:
-        fns = [n.fn for n in nodes]
+        fns = [n2.fn for n2 in nodes]
         wiring = descs_all
         live = [
             (i, j)
-            for i, n in enumerate(nodes)
-            for j in range(n.n_out)
-            if n.out_refs[j]() is not None
+            for i, n2 in enumerate(nodes)
+            for j in range(n2.n_out)
+            if n2.out_refs[j]() is not None
         ]
 
-        def replay(leaf_vals):
+        def replay(*leaf_vals):
             env: list = [None] * len(fns)
             for i, f in enumerate(fns):
                 args = [
@@ -466,20 +577,51 @@ def _flush_impl(g: _Graph):
                 env[i] = tuple(o) if isinstance(o, (tuple, list)) else (o,)
             return [env[i][j] for (i, j) in live]
 
-        entry = (jax.jit(replay), live, replay)
+        jitted = (
+            jax.jit(replay, donate_argnums=donate_ix) if donate_ix else jax.jit(replay)
+        )
+        # list, not tuple: the donation-error fallback swaps in a
+        # non-donating executable under the same signature
+        entry = [jitted, live, replay, donate_ix]
         if sig is not None:
             _flush_cache[sig] = entry
             if len(_flush_cache) > _FLUSH_CACHE_MAX:
                 _flush_cache.popitem(last=False)
     else:
         _flush_cache.move_to_end(sig)
+        prof.counter_inc("lazy_cache_hits")
 
-    jitted, live, replay = entry
+    jitted, live, replay, don = entry
     try:
-        results = jitted(leaves)
+        if don:
+            _ignore_donation_warnings()
+        results = jitted(*leaves)
+        if don:
+            prof.counter_inc("lazy_donated_buffers", len(don))
     except Exception:
-        # fallback: run un-jitted (still one pass, concrete ops)
-        results = replay([jnp.asarray(x) for x in leaves])
+        donated_dead = any(
+            getattr(l, "is_deleted", _false)()
+            for l in leaves
+            if isinstance(l, jax.Array)
+        )
+        if don and not donated_dead:
+            # XLA rejected the donation (or the donating executable failed
+            # before invalidating inputs): permanently fall back to a
+            # non-donating executable under this signature
+            prof.counter_inc("lazy_donation_fallbacks")
+            jitted = jax.jit(replay)
+            entry[0] = jitted
+            entry[3] = ()
+            try:
+                results = jitted(*leaves)
+            except Exception:
+                results = replay(*[jnp.asarray(v) for v in leaves])
+        elif donated_dead:
+            # inputs were invalidated mid-execution; eager replay impossible
+            raise
+        else:
+            # fallback: run un-jitted (still one pass, concrete ops)
+            results = replay(*[jnp.asarray(v) for v in leaves])
 
     for (i, j), val in zip(live, results):
         o = nodes[i].out_refs[j]()

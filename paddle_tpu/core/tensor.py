@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from . import dtype as dtypes
 from . import place as place_mod
 from .engine import run_backward, no_grad
-from .lazy import LazyArray
+from .lazy import LazyArray, note_rebound
 
 _tensor_count = 0
 
@@ -184,7 +184,13 @@ class Tensor:
 
     # -- in-place / value management (optimizer fast path) ----------------
     def _set_data(self, arr):
-        """Replace the underlying buffer (used by optimizers & loaders)."""
+        """Replace the underlying buffer (used by optimizers & loaders).
+        The displaced buffer becomes a donation candidate for the pending
+        lazy flush — if it only feeds the queued computation (the optimizer
+        rebind pattern), XLA gets to update it in place."""
+        old = self._data
+        if old is not arr:
+            note_rebound(old)
         self._data = arr
 
     def set_value(self, value):
@@ -195,6 +201,7 @@ class Tensor:
             raise ValueError(
                 f"set_value shape mismatch: {arr.shape} vs {self._data.shape}"
             )
+        note_rebound(self._data)
         self._data = arr
 
     def copy_(self, other):

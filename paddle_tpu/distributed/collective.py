@@ -97,8 +97,10 @@ def _axis_bound(axis: str) -> bool:
     """True only inside a shard_map/pmap scope where ``axis`` is a manual
     axis. Under plain jit/GSPMD this is False — the partitioner owns comms
     there and explicit collectives must be identities."""
+    from ..core.compat import axis_size
+
     try:
-        lax.axis_size(axis)
+        axis_size(axis)
         return True
     except Exception:
         return False

@@ -12,8 +12,11 @@ import math
 
 import numpy as np
 import jax
+
 import jax.numpy as jnp
 from jax import lax
+
+from ....core.compat import axis_size
 
 from ....core.tensor import Tensor
 from ....nn import functional as F
@@ -51,7 +54,7 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, n_experts, capacity_factor=1
     )
 
     if axis_name is not None:
-        ep = lax.axis_size(axis_name)
+        ep = axis_size(axis_name)
         local_e = E // ep
         # (E, C, D) → (ep, local_e, C, D) → all_to_all → experts local
         b = buckets.reshape(ep, local_e, capacity, D)
@@ -111,7 +114,7 @@ class MoELayer(Layer):
             def expert_fn(buckets, local=False):
                 wu, wd = w_up, w_down
                 if local and axis is not None:
-                    ep = lax.axis_size(axis)
+                    ep = axis_size(axis)
                     # my local experts tiled over incoming rank-blocks
                     local_e = n_experts // ep
                     wu = jnp.tile(wu[:local_e], (ep, 1, 1)) if wu.shape[0] != buckets.shape[0] else wu

@@ -212,15 +212,21 @@ class PipelineTrainStep:
         call_stage = self._stage_caller(carrier_dtype)
 
         def step_fn(param_arrays, opt_state, ids_mb, labels_mb, lr, key):
-            def loss_of(p_arrays):
-                def spmd(p_arrays, ids_mb, labels_mb):
+            # AD runs INSIDE the shard_map body (like the 1F1B builder):
+            # differentiating THROUGH a shard_map trips its transpose rule on
+            # jax<=0.4 (_SpecError on scalar residuals with the replication
+            # check off). In-body value_and_grad sees ppermute/switch/scan as
+            # ordinary traceable ops, and grads psum in-body like 1F1B's.
+            def spmd(p_arrays, ids_mb, labels_mb):
+                stage_id = lax.axis_index(axis)
+
+                def local_loss(p_arrays):
                     def branch(s):
                         def run(x, ids_t, lbl_t, k):
                             return call_stage(p_arrays, s, x, ids_t, lbl_t, k)
                         return run
 
                     branches = [branch(s) for s in range(n_stages)]
-                    stage_id = lax.axis_index(axis)
 
                     def tick(carry, t):
                         x, loss_acc = carry
@@ -242,28 +248,34 @@ class PipelineTrainStep:
                     (_, loss_acc), _ = lax.scan(
                         tick, (x0, jnp.float32(0.0)), jnp.arange(n_micro + n_stages - 1)
                     )
-                    return lax.psum(loss_acc, axis) / n_micro
+                    return loss_acc / n_micro  # per-device partial
 
-                from jax.sharding import PartitionSpec as P
-
-                from ...mesh import shard_map_compat
-
-                _shard_map, _check = shard_map_compat()
-
-                fn = _shard_map(
-                    spmd,
-                    mesh=self.mesh,
-                    in_specs=(
-                        tuple(P() for _ in p_arrays), P(), P(),
-                    ),
-                    out_specs=P(),
-                    **_check,
+                lval, gval = jax.value_and_grad(local_loss)(p_arrays)
+                loss = lax.psum(lval, axis)
+                grads = tuple(
+                    lax.psum(g.astype(jnp.float32), axis).astype(a.dtype)
+                    for g, a in zip(gval, p_arrays)
                 )
-                return fn(tuple(p_arrays), ids_mb, labels_mb)
+                return loss, grads
 
-            loss, grads = jax.value_and_grad(loss_of)(list(param_arrays))
+            from jax.sharding import PartitionSpec as P
+
+            from ...mesh import shard_map_compat
+
+            _shard_map, _check = shard_map_compat()
+
+            fn = _shard_map(
+                spmd,
+                mesh=self.mesh,
+                in_specs=(
+                    tuple(P() for _ in param_arrays), P(), P(),
+                ),
+                out_specs=(P(), tuple(P() for _ in param_arrays)),
+                **_check,
+            )
+            loss, grads = fn(tuple(param_arrays), ids_mb, labels_mb)
             new_params, new_state = self.optimizer._functional_update(
-                param_arrays, grads, opt_state, lr, params=params
+                param_arrays, list(grads), opt_state, lr, params=params
             )
             return loss, new_params, new_state
 

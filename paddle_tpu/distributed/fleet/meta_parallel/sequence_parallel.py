@@ -19,8 +19,11 @@ import math
 from functools import partial
 
 import jax
+
 import jax.numpy as jnp
 from jax import lax
+
+from ....core.compat import axis_size
 
 
 _Q_CHUNK = 512  # per-chunk score block is (C, T_local): memory ∝ C·T, not T²
@@ -85,7 +88,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
     """q,k,v: (B, T_local, H, D) — local sequence shard. Call inside shard_map
     over ``axis_name``. Returns (B, T_local, H, D).
     """
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     t_local = q.shape[1]
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -151,7 +154,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
 def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
     """Ulysses: all_to_all seq-shard → head-shard, local attention, back.
     q,k,v: (B, T_local, H, D) with H divisible by sp."""
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
 
     def seq_to_heads(x):
         # (B, T/sp, H, D) -> (B, T, H/sp, D); tiled all_to_all has a clean
@@ -193,7 +196,7 @@ def _local_attention(qg, kg, vg, causal):
 
 def split_sequence(x, axis_name="sp", seq_axis=1):
     """Slice this rank's sequence shard (inside shard_map)."""
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     size = x.shape[seq_axis] // sp
     return lax.dynamic_slice_in_dim(x, idx * size, size, axis=seq_axis)

@@ -40,16 +40,12 @@ def global_mesh() -> Mesh:
 
 
 def shard_map_compat():
-    """(shard_map, check_kwargs) across jax versions: the stable ``jax.shard_map``
-    takes ``check_vma``; the older experimental API takes ``check_rep``."""
-    try:
-        from jax import shard_map as sm
+    """(shard_map, check_kwargs) across jax versions — delegates to the
+    one-file shim in ``core/compat.py`` (the stable ``jax.shard_map`` takes
+    ``check_vma``; the older experimental API takes ``check_rep``)."""
+    from ..core.compat import shard_map, shard_map_check_kwargs
 
-        return sm, {"check_vma": False}
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as sm
-
-        return sm, {"check_rep": False}
+    return shard_map, shard_map_check_kwargs(False)
 
 
 def mesh_axis_size(axis: str) -> int:

@@ -19,6 +19,16 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_use_bf16_matmul": True,         # TPU-native: allow bf16 matmul precision
     "FLAGS_jit_cache_size": 4096,
     "FLAGS_log_level": 0,
+    # Lazy-flush buffer donation: dead-after-flush inputs (rebound params,
+    # optimizer moments, accumulated grads) are passed as donate_argnums so
+    # XLA updates weights in place instead of copying ~3x model size per
+    # step. FLAGS_lazy_donate=0 is the kill-switch.
+    "FLAGS_lazy_donate": True,
+    # JAX persistent compilation cache (warm executable starts across
+    # processes). Dir defaults to ~/.cache/paddle_tpu/xla when unset.
+    "FLAGS_xla_persistent_cache": True,
+    "FLAGS_xla_persistent_cache_dir": "",
+    "FLAGS_xla_persistent_cache_min_compile_secs": 0.5,
 }
 
 # Env pickup at import (reference: gflags env integration)

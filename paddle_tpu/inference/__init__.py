@@ -186,7 +186,8 @@ class Predictor:
         if prefix is None or not os.path.exists(prefix + ".pdmodel"):
             raise ValueError(f"model file not found: {prefix}.pdmodel")
         with open(prefix + ".pdmodel", "rb") as f:
-            exported = jax.export.deserialize(f.read())
+            from ..core.compat import jax_export
+            exported = jax_export().deserialize(f.read())
         meta = fload(config.params_file()) if os.path.exists(config.params_file()) else {}
         specs = meta.get("specs") or []
         self._exported = exported

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from ..core import lazy as _lazy
 from ..core import random as random_state
+from ..core.compat import jax_export as _jax_export
 from ..core.engine import GradNode, grad_enabled, no_grad
 from ..core.tensor import Parameter, Tensor
 from ..static.input import InputSpec
@@ -392,14 +393,14 @@ def save(layer, path, input_spec=None, **configs):
     # dynamic batch). One shared scope across all inputs.
     has_dynamic = any(d is None or d == -1 for s in specs for d in s.shape)
     if has_dynamic:
-        scope = jax.export.SymbolicScope()
+        scope = _jax_export().SymbolicScope()
         args = []
         for si, s in enumerate(specs):
             dims = ",".join(
                 f"d{si}_{di}" if (d is None or d == -1) else str(d)
                 for di, d in enumerate(s.shape)
             )
-            shape = jax.export.symbolic_shape(dims, scope=scope) if dims else ()
+            shape = _jax_export().symbolic_shape(dims, scope=scope) if dims else ()
             args.append(jax.ShapeDtypeStruct(shape, s.dtype))
     else:
         args = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype) for s in specs]
@@ -408,11 +409,11 @@ def save(layer, path, input_spec=None, **configs):
         # (Config.disable_gpu / CPU-only serving); ops without a multi-
         # platform lowering (e.g. Pallas kernels) fall back to native-only
         try:
-            return jax.export.export(jax.jit(pure), platforms=("cpu", "tpu"))(*arg_list)
+            return _jax_export().export(jax.jit(pure), platforms=("cpu", "tpu"))(*arg_list)
         except Exception:
             # no multi-platform lowering (e.g. Pallas kernels): retry native-
             # only; a second failure chains the original via __context__
-            return jax.export.export(jax.jit(pure))(*arg_list)
+            return _jax_export().export(jax.jit(pure))(*arg_list)
 
     try:
         exported = _export(args)
@@ -472,10 +473,10 @@ def save(layer, path, input_spec=None, **configs):
             try:
                 # same (possibly symbolic) feed shapes as the primal export,
                 # so load→append_backward→train works at any batch size
-                exp_train = jax.export.export(jax.jit(pure_train))(p_args, *args)
+                exp_train = _jax_export().export(jax.jit(pure_train))(p_args, *args)
             except Exception:
                 # vjp not shape-polymorphic for some op: static fallback
-                exp_train = jax.export.export(jax.jit(pure_train))(p_args, *static_args)
+                exp_train = _jax_export().export(jax.jit(pure_train))(p_args, *static_args)
             with open(path + ".pdtrain", "wb") as f:
                 f.write(exp_train.serialize(vjp_order=1))
             with open(path + ".pdtrain.json", "w") as f:
@@ -515,7 +516,7 @@ class TranslatedLayer:
 def load(path, **configs):
     with open(path + ".pdmodel", "rb") as f:
         blob = f.read()
-    exported = jax.export.deserialize(blob)
+    exported = _jax_export().deserialize(blob)
     from ..framework.io import load as fload
 
     meta = fload(path + ".pdiparams")

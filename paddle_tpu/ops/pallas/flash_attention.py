@@ -18,6 +18,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from ...core.compat import enable_x64
 
 try:
     from jax.experimental import pallas as pl
@@ -100,11 +101,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *, bloc
         lse_ref[0, 0, :] = m_sc[:] + jnp.log(l_safe)
 
 
+def _kernel_x64_off(interpret):
+    """Mosaic has no i64/f64 lowering, so the real-kernel trace runs with x64
+    off. Interpret mode (CPU) handles 64-bit fine — and toggling x64 inside
+    an outer x64 trace (jit/shard_map around the model) makes the
+    interpreter's grid loops mix i32/i64 on jax<=0.4 — so leave it alone."""
+    import contextlib
+
+    return contextlib.nullcontext() if interpret else enable_x64(False)
+
+
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len):
     # q: (BH, T, D). Traced with x64 disabled: the framework enables x64
     # globally (paddle int64 semantics) but Mosaic has no i64/f64 lowering —
     # index maps and weak python scalars must stay 32-bit inside the kernel.
-    with jax.enable_x64(False):
+    with _kernel_x64_off(interpret):
         return _flash_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len)
 
 
@@ -792,20 +803,20 @@ def _flash_hd_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpr
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_hd(q, k, v, causal, block_q, block_k, interpret, kv_len, d, hp):
-    with jax.enable_x64(False):
+    with _kernel_x64_off(interpret):
         out, _ = _flash_hd_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len, d, hp)
     return out
 
 
 def _flash_hd_vjp_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len, d, hp):
-    with jax.enable_x64(False):
+    with _kernel_x64_off(interpret):
         out, lse = _flash_hd_fwd_inner(q, k, v, causal, block_q, block_k, interpret, kv_len, d, hp)
     return out, (q, k, v, out, lse)
 
 
 def _flash_hd_vjp_bwd(causal, block_q, block_k, interpret, kv_len, d, hp, res, do):
     q, k, v, out, lse = res
-    with jax.enable_x64(False):
+    with _kernel_x64_off(interpret):
         return _flash_hd_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret, kv_len, d, hp)
 
 
@@ -1206,7 +1217,7 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, kv_len, res, do):
     # blocks per key block (causal lower bound skips fully-masked blocks).
     # No (BQ,T) score block or (n_q,BH,T,D) intermediate ever reaches HBM.
     q, k, v, out, lse = res
-    with jax.enable_x64(False):
+    with _kernel_x64_off(interpret):
         return _flash_bwd_inner(q, k, v, out, lse, do, causal, block_q, block_k, interpret, kv_len)
 
 

@@ -186,8 +186,12 @@ class Optimizer:
                 [p._data, g, lr, t] + [st[k] for k in keys],
                 key=("opt", type(self).__name__, self._uid, keys, wd, plr),
             )
+            # rebind param + moments through the graph: the displaced buffers
+            # become donation candidates, so the flushed executable updates
+            # weights and optimizer state in place (no ~3x-model-size copy)
             p._set_data(outs[0])
             for k, v in zip(keys, outs[1:]):
+                lazy_mod.note_rebound(st[k])
                 st[k] = v
         # step boundary: flush now so every train iteration is ONE stable
         # graph signature ([fwd+bwd+opt]) that hits the executable cache,

@@ -12,7 +12,7 @@ import contextlib
 import json
 import os
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 
@@ -43,6 +43,29 @@ class _Event:
 
 _events: List[_Event] = []
 _enabled = False
+
+# Engine counters (always on — integer bumps at flush/step granularity, not
+# per-op): lazy-flush executable cache behavior and buffer donation. The
+# donation counter counts argument positions PASSED as donate_argnums; on
+# backends that ignore the aliasing hint the count still reflects what the
+# liveness pass proved dead.
+_counters: Dict[str, int] = {}
+
+
+def counter_inc(name: str, n: int = 1):
+    _counters[name] = _counters.get(name, 0) + n
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of engine counters: ``lazy_flushes``, ``lazy_cache_hits``,
+    ``lazy_donated_buffers``, ``lazy_donation_fallbacks`` (always on), and
+    ``dispatch_fastkey_hits`` (per-op — only counted while the profiler is
+    running, to keep the dispatch hot path free of bookkeeping)."""
+    return dict(_counters)
+
+
+def reset_counters():
+    _counters.clear()
 
 # Native host recorder (runtime_cpp/trace.cc) when built — GIL-cheap record.
 _native = None

@@ -323,7 +323,8 @@ class TrainableProgram:
         import json as _json
 
         with open(path_prefix + ".pdtrain", "rb") as f:
-            exported = jax.export.deserialize(f.read())
+            from ..core.compat import jax_export
+            exported = jax_export().deserialize(f.read())
         with open(path_prefix + ".pdtrain.json") as f:
             param_names = _json.load(f)["param_names"]
         from ..framework.io import load as fload

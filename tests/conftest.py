@@ -36,6 +36,7 @@ def _seed():
 # the full suite is the per-round regression sweep.
 _SMOKE_MODULES = {
     "test_tensor_ops", "test_autograd", "test_lazy", "test_regressions",
+    "test_lazy_donation",
 }
 
 

@@ -1,0 +1,29 @@
+"""Collection-error tripwire.
+
+Tier-1 runs with ``--continue-on-collection-errors``, so a version-drift
+ImportError in one test module silently drops that whole file from the suite
+(it happened: three distributed files fell out on a jax upgrade and nothing
+failed loudly). This test collects the full suite in a subprocess and FAILS
+if any module errors at collection time — the drop becomes a red test.
+"""
+import os
+import subprocess
+import sys
+
+
+def test_full_suite_collects_cleanly():
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", tests_dir, "-q", "--collect-only",
+            "-p", "no:cacheprovider",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=os.path.dirname(tests_dir),
+    )
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"collection failed (rc={proc.returncode}):\n{out[-4000:]}"
+    assert "ERROR" not in out, f"collection errors:\n{out[-4000:]}"

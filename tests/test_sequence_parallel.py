@@ -8,7 +8,7 @@ import math
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from paddle_tpu.core.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import paddle_tpu as paddle
