@@ -120,6 +120,7 @@ from . import static  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .framework.flags import set_flags, get_flags  # noqa: F401
 from . import distributed  # noqa: F401
+from . import fault  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import text  # noqa: F401

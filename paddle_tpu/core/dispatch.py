@@ -40,6 +40,11 @@ def _prof():
 # AMP hook — set by paddle_tpu.amp.auto_cast; signature (op_name, tensors) -> tensors
 _amp_hook: Optional[Callable] = None
 
+# Fault-injection hook — set to the paddle_tpu.fault.inject module by
+# inject.arm(), back to None by inject.disarm(). The disarmed hot path pays
+# one `is not None` check per op.
+_fault_inject = None
+
 
 def set_amp_hook(hook):
     global _amp_hook
@@ -123,7 +128,31 @@ def _get_jitted(fn, attrs):
     return jf
 
 
-def _check_nan_inf(name, outs):
+def _nonfinite_error(name, idx, arr, origin="eager", hint=False):
+    """Build the FLAGS_check_nan_inf diagnostic (reference
+    nan_inf_utils_detail.cc prints tensor meta + offending values): which
+    output, its shape/dtype, how many non-finite elements, and where the
+    first one sits."""
+    a = np.asarray(arr)
+    bad = ~np.isfinite(a)
+    cnt = int(bad.sum())
+    flat_idx = int(np.flatnonzero(bad.ravel())[0]) if cnt else -1
+    first = a.ravel()[flat_idx] if cnt else None
+    msg = (
+        f"Operator '{name}' output {idx} (shape={tuple(a.shape)}, "
+        f"dtype={a.dtype}) contains {cnt} non-finite value(s); first at flat "
+        f"index {flat_idx} = {first!r} [{origin}] (FLAGS_check_nan_inf is set)."
+    )
+    if hint:
+        msg += (
+            " Set FLAGS_check_nan_inf_per_op=1 to re-run the pending graph "
+            "unfused and attribute the first non-finite value to its "
+            "producing op."
+        )
+    return FloatingPointError(msg)
+
+
+def _check_nan_inf(name, outs, origin="eager"):
     # FLAGS_check_nan_inf debug scan — the reference checks every op output
     # when the flag is set (operator.cc:1171 → nan_inf_utils_detail.cc).
     # Host-side isfinite forces a device sync per op; that's the documented
@@ -133,10 +162,7 @@ def _check_nan_inf(name, outs):
     for i, o in enumerate(outs):
         if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating):
             if not bool(jnp.isfinite(o).all()):
-                raise FloatingPointError(
-                    f"Operator '{name}' output {i} contains NaN or Inf "
-                    f"(FLAGS_check_nan_inf is set)."
-                )
+                raise _nonfinite_error(name, i, o, origin=origin)
 
 
 def eager_call(
@@ -158,15 +184,19 @@ def eager_call(
     if p._enabled:
         _t0 = _time.perf_counter_ns()
         try:
-            return _eager_call_impl(
+            res = _eager_call_impl(
                 name, fn, tensor_args, attrs, differentiable,
                 nondiff_outputs, fn_key,
             )
         finally:
             p._record("op::" + name, _t0)
-    return _eager_call_impl(
-        name, fn, tensor_args, attrs, differentiable, nondiff_outputs, fn_key
-    )
+    else:
+        res = _eager_call_impl(
+            name, fn, tensor_args, attrs, differentiable, nondiff_outputs, fn_key
+        )
+    if _fault_inject is not None and _fault_inject.should_fire("tensor.nan", op=name):
+        _fault_inject.poison_first_nan(res)
+    return res
 
 
 def _eager_call_impl(
@@ -194,10 +224,12 @@ def _eager_call_impl(
 
     # Lazy batching path: queue the op; execution happens in one XLA
     # computation at the next materialization point. Bypassed under jit
-    # tracing (tracer inputs), in debug nan-check mode, and for unhashable
-    # attrs (no stable executable-cache key).
+    # tracing (tracer inputs) and for unhashable attrs (no stable
+    # executable-cache key). FLAGS_check_nan_inf does NOT bypass: the guard
+    # runs as a post-flush scan (lazy.py), so the fused step keeps its
+    # fusion and still raises within the same step the NaN is produced.
     has_tracer = any(isinstance(a, jax.core.Tracer) for a in arrays)
-    if not check_naninf and not has_tracer and lazy_mod.lazy_enabled():
+    if not has_tracer and lazy_mod.lazy_enabled():
         try:
             attrs_key = _attrs_key(attrs)
         except TypeError:

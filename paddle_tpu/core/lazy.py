@@ -460,6 +460,25 @@ _flush_cache: "collections.OrderedDict" = collections.OrderedDict()
 _FLUSH_CACHE_MAX = 128
 
 
+def _interp(fns, wiring, leaf_vals, on_node=None):
+    """The one interpreter for the graph wiring descriptors
+    (``("l", leaf_ix)`` / ``("n", node_ix, out_ix)``): used traced inside the
+    jitted replay AND eagerly by the per-op nan checker — one format, one
+    reader. Returns the per-node output env; ``on_node(i, outs)`` observes
+    each node as it lands."""
+    env: list = [None] * len(fns)
+    for i, f in enumerate(fns):
+        args = [
+            leaf_vals[d[1]] if d[0] == "l" else env[d[1]][d[2]]
+            for d in wiring[i]
+        ]
+        o = f(*args)
+        env[i] = tuple(o) if isinstance(o, (tuple, list)) else (o,)
+        if on_node is not None:
+            on_node(i, env[i])
+    return env
+
+
 def flush():
     """Execute all pending nodes as one jitted XLA computation and write the
     results back into the live LazyArrays."""
@@ -534,13 +553,22 @@ def _flush_impl(g: _Graph):
     # Liveness pass: donate leaves that were rebound through this graph and
     # that nothing outside the graph still references. The mask is part of
     # the executable signature, so a cache hit always replays with the same
-    # donation layout it was compiled with.
+    # donation layout it was compiled with. Donation is SUPPRESSED while
+    # FLAGS_check_nan_inf is set: a donated buffer is destroyed by the flush,
+    # and on a NaN trip the pre-step state must survive for inspection (and
+    # for the per-op unfused replay).
     from ..framework import flags as _flags
 
+    check_nan = bool(_flags.flag("FLAGS_check_nan_inf", False))
     donate_ix: tuple = ()
     cand = getattr(_state, "donate_ids", None)
     if cand and _flags.flag("FLAGS_lazy_donate", True):
-        donate_ix = _donation_mask(leaves, cand, direct_uses, via_lazy)
+        if check_nan:
+            from .dispatch import _prof as _prof_fn
+
+            _prof_fn().counter_inc("naninf_donation_suppressed")
+        else:
+            donate_ix = _donation_mask(leaves, cand, direct_uses, via_lazy)
     if cand:
         cand.clear()
 
@@ -567,14 +595,7 @@ def _flush_impl(g: _Graph):
         ]
 
         def replay(*leaf_vals):
-            env: list = [None] * len(fns)
-            for i, f in enumerate(fns):
-                args = [
-                    leaf_vals[d[1]] if d[0] == "l" else env[d[1]][d[2]]
-                    for d in wiring[i]
-                ]
-                o = f(*args)
-                env[i] = tuple(o) if isinstance(o, (tuple, list)) else (o,)
+            env = _interp(fns, wiring, leaf_vals)
             return [env[i][j] for (i, j) in live]
 
         jitted = (
@@ -627,6 +648,47 @@ def _flush_impl(g: _Graph):
         o = nodes[i].out_refs[j]()
         if o is not None:
             o._concrete = val
+
+    # FLAGS_check_nan_inf under the lazy engine: scan the flush outputs AFTER
+    # the writeback (the materialized state stays inspectable — donation was
+    # suppressed above, so pre-step buffers survive too) and raise within the
+    # same step the NaN was produced.
+    if check_nan:
+        _postflush_nan_check(nodes, live, results, leaves, descs_all)
+
+
+def _postflush_nan_check(nodes, live, results, leaves, descs_all):
+    """Post-flush nan/inf scan (reference operator.cc:1171 semantics adapted
+    to fused execution). Default mode scans the LIVE flush outputs — a NaN
+    in an intermediate that was fused away AND masked out of every live
+    output is invisible (the price of keeping fusion). Opt-in
+    FLAGS_check_nan_inf_per_op re-runs the graph UNFUSED on every flush and
+    checks EVERY node output — full reference parity (dead intermediates
+    included) at the reference's documented debug cost (~2x compute)."""
+    from ..framework import flags as _flags
+    from .dispatch import _nonfinite_error, _prof
+
+    if _flags.flag("FLAGS_check_nan_inf_per_op", False):
+        # Unfused replay: same wiring, eager ops, every node output checked,
+        # first offender attributed to its producing op.
+        def check_node(i2, outs):
+            for j2, out in enumerate(outs):
+                if hasattr(out, "dtype") and jnp.issubdtype(out.dtype, jnp.floating):
+                    if not bool(jnp.isfinite(out).all()):
+                        _prof().counter_inc("naninf_trips")
+                        raise _nonfinite_error(
+                            nodes[i2].key[0], j2, out, origin="lazy per-op replay"
+                        )
+
+        _interp([n2.fn for n2 in nodes], descs_all, leaves, on_node=check_node)
+        return
+    for (i, j), val in zip(live, results):
+        if hasattr(val, "dtype") and jnp.issubdtype(val.dtype, jnp.floating):
+            if not bool(jnp.isfinite(val).all()):
+                _prof().counter_inc("naninf_trips")
+                raise _nonfinite_error(
+                    nodes[i].key[0], j, val, origin="lazy flush", hint=True
+                )
 
 
 # -- helpers for the autograd engine ----------------------------------------

@@ -1,4 +1,4 @@
-"""Distributed (sharded, async) checkpointing + auto-resume.
+"""Distributed (sharded, async) checkpointing + crash-safe auto-resume.
 
 Parity: reference distributed save/load (``fleet.utils.fs`` +
 ``incubate/checkpoint/auto_checkpoint.py:71`` — periodic checkpoint with
@@ -6,6 +6,16 @@ automatic resume) and sharded state persistence. TPU-native: orbax — each
 host writes only its own shards of a GSPMD-sharded train state (no gather to
 host 0), restore re-places shards per the target sharding; the async saver
 overlaps serialization with the next training steps.
+
+Crash safety: every checkpoint carries a MANIFEST (``<path>.manifest.json``,
+written via tmp + ``os.replace`` ONLY after the orbax write finalized) with
+the flat array tree, per-leaf CRC32 checksums and a commit marker. The
+manifest is the source of truth for resume: ``AutoCheckpoint.resume`` walks
+back to the newest checkpoint whose manifest verifies (including the
+``.old`` backup parked aside by an in-place re-save) instead of trusting
+``latest.json``, and GC never deletes the last verified-good copy. A save
+that dies at ANY point leaves either the previous manifest+dir intact or an
+uncommitted dir that resume skips.
 """
 from __future__ import annotations
 
@@ -13,14 +23,44 @@ import json
 import os
 import shutil
 import time
-from typing import Any, Dict, Optional
+import warnings
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..core.lazy import concrete as _concrete
 import jax
+import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..framework import flags as _flags
+
+MANIFEST_SUFFIX = ".manifest.json"
+_MANIFEST_FORMAT = 1
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint failed verification (missing manifest, checksum
+    mismatch, or tree mismatch under strict loading)."""
+
+
+def _prof():
+    from .. import profiler
+
+    return profiler
+
+
+def _has_state_dict(v) -> bool:
+    """Model/optimizer-like tree nodes: anything exposing ``state_dict()``
+    (nn.Layer, Optimizer, LRScheduler). They participate in the checkpoint
+    tree as nested dicts and restore through ``set_state_dict`` — so a train
+    loop checkpoints ``{"model": model, "optimizer": opt}`` directly and
+    resume brings back Adam moments / step counts, not just params."""
+    return (
+        not isinstance(v, (Tensor, dict))
+        and callable(getattr(v, "state_dict", None))
+    )
 
 
 def _to_arrays(state: Dict[str, Any]):
@@ -31,8 +71,14 @@ def _to_arrays(state: Dict[str, Any]):
             out[k] = _concrete(v._data)
         elif isinstance(v, dict):
             out[k] = _to_arrays(v)
+        elif _has_state_dict(v):
+            out[k] = _to_arrays(dict(v.state_dict()))
+        elif isinstance(v, (bool, int, float)):
+            # scalar metadata (e.g. an optimizer's "@step") — normalize to an
+            # array so orbax round-trips it
+            out[k] = np.asarray(v)
         else:
-            out[k] = v
+            out[k] = _concrete(v)
     return out
 
 
@@ -48,6 +94,155 @@ def _apply_arrays(state: Dict[str, Any], arrays: Dict[str, Any]):
             v._set_data(arr.astype(v._data.dtype) if hasattr(arr, "astype") else arr)
         elif isinstance(v, dict) and isinstance(a, dict):
             _apply_arrays(v, a)
+        elif _has_state_dict(v) and isinstance(a, dict):
+            if callable(getattr(v, "set_state_dict", None)):
+                v.set_state_dict(a)
+            else:
+                _apply_arrays(dict(v.state_dict()), a)
+
+
+def _flat_keys(tree: Dict[str, Any], prefix: str = ""):
+    """Yield (flat_key, leaf) for every non-dict leaf, '/'-joined."""
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            yield from _flat_keys(v, key)
+        else:
+            yield key, v
+
+
+def _tree_keys(state: Dict[str, Any]):
+    """Flat key sets of a STATE tree for strict comparison: exact keys for
+    Tensor/plain leaves, root prefixes for state_dict-bearing objects (their
+    inner key set is owned by set_state_dict — e.g. a fresh optimizer has no
+    accumulator slots until the first step, yet absorbs them on restore)."""
+    exact, objroots = set(), set()
+
+    def walk(tree, prefix):
+        for k, v in tree.items():
+            key = f"{prefix}/{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                walk(v, key)
+            elif _has_state_dict(v):
+                objroots.add(key)
+            else:
+                exact.add(key)
+
+    walk(state, "")
+    return exact, objroots
+
+
+def _own_leaves(tree):
+    """Copy restored leaves into buffers OWNED by jax's allocator. Orbax
+    hands back TensorStore-backed ``jax.Array``s (and numpy leaves) that can
+    alias restore-pool memory; if such a buffer later becomes a lazy-flush
+    donation target, XLA writes the updated value into memory whose real
+    owner can reclaim it, and the NEXT flush reads garbage — observed as
+    nondeterministic NaN/divergence on the first steps after resume.
+    ``jnp.array(copy=True)`` severs the alias at the restore boundary."""
+    if isinstance(tree, dict):
+        return {k: _own_leaves(v) for k, v in tree.items()}
+    if isinstance(tree, np.ndarray):
+        return jnp.array(tree)  # copy=True default: never borrows
+    if isinstance(tree, jax.Array):
+        try:
+            if not tree.is_fully_addressable:
+                return tree  # multihost shard: copying would gather/crash
+            sharding = getattr(tree, "sharding", None)
+            copied = jnp.array(tree)
+            # re-place: the copy lands on the default device, but sharded
+            # restores must keep their layout for non-Tensor consumers too
+            return jax.device_put(copied, sharding) if sharding is not None else copied
+        except Exception:
+            return tree
+    return tree
+
+
+def _leaf_crc(a) -> Optional[int]:
+    """CRC32 of a leaf's host bytes; None when the leaf has no stable byte
+    view (non-addressable multihost shards, odd python objects) — such
+    leaves are recorded but skipped by verification."""
+    try:
+        n = np.asarray(a)
+        return zlib.crc32(n.tobytes()) & 0xFFFFFFFF
+    except Exception:
+        return None
+
+
+# -- manifest ----------------------------------------------------------------
+def _manifest_path(path: str) -> str:
+    return path + MANIFEST_SUFFIX
+
+
+def _build_manifest(arrays: Dict[str, Any], step: Optional[int] = None) -> dict:
+    tree = {}
+    for key, leaf in _flat_keys(arrays):
+        entry = {"crc32": _leaf_crc(leaf)}
+        if hasattr(leaf, "shape"):
+            entry["shape"] = list(np.shape(leaf))
+            entry["dtype"] = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        tree[key] = entry
+    man = {"format": _MANIFEST_FORMAT, "ts": time.time(), "committed": True, "tree": tree}
+    if step is not None:
+        man["step"] = int(step)
+    return man
+
+
+def _write_manifest(man: dict, ckpt_path: str) -> None:
+    """Atomic commit marker: the manifest lands via tmp + os.replace only
+    after the checkpoint data is durable, so its presence IS the commit."""
+    mp = _manifest_path(ckpt_path)
+    tmp = mp + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, mp)
+
+
+def read_manifest(ckpt_path: str) -> Optional[dict]:
+    """The checkpoint's manifest, or None (legacy/uncommitted checkpoint)."""
+    mp = _manifest_path(ckpt_path)
+    try:
+        with open(mp) as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return man if isinstance(man, dict) else None
+
+
+def _verify_against_manifest(arrays: Dict[str, Any], man: dict, path: str) -> None:
+    tree = man.get("tree", {})
+    restored = dict(_flat_keys(arrays))
+    missing = sorted(set(tree) - set(restored))
+    if missing:
+        raise CheckpointError(
+            f"checkpoint {path}: manifest lists keys absent from the restored "
+            f"tree: {missing}"
+        )
+    for key, entry in tree.items():
+        want = entry.get("crc32")
+        if want is None:
+            continue
+        got = _leaf_crc(restored[key])
+        if got is not None and got != want:
+            raise CheckpointError(
+                f"checkpoint {path}: checksum mismatch for '{key}' "
+                f"(manifest crc32={want}, restored crc32={got})"
+            )
+
+
+def _move_manifest(src_ckpt: str, dst_ckpt: str) -> None:
+    mp = _manifest_path(src_ckpt)
+    if os.path.exists(mp):
+        os.replace(mp, _manifest_path(dst_ckpt))
+
+
+def _remove_manifest(ckpt_path: str) -> None:
+    try:
+        os.remove(_manifest_path(ckpt_path))
+    except OSError:
+        pass
 
 
 def _ckpt(async_mode=False):
@@ -58,61 +253,160 @@ def _ckpt(async_mode=False):
     return ocp.StandardCheckpointer()
 
 
-def save_state_dict(state_dict: Dict[str, Any], path: str, async_save: bool = False):
+class _PendingSave:
+    """Handle for an async save: ``wait_until_finished`` blocks on the orbax
+    background write and THEN commits the manifest — a crash before the wait
+    leaves the checkpoint uncommitted and resume walks past it."""
+
+    def __init__(self, ck, manifest: Optional[dict], path: str, old: Optional[str]):
+        self._ck = ck
+        self._manifest = manifest
+        self._path = path
+        self._old = old
+        self._done = False
+
+    def wait_until_finished(self):
+        if self._done:
+            return
+        self._ck.wait_until_finished()
+        if self._manifest is not None:
+            _write_manifest(self._manifest, self._path)
+        _prof().counter_inc("ckpt_saves")
+        self._done = True
+
+
+def save_state_dict(
+    state_dict: Dict[str, Any],
+    path: str,
+    async_save: bool = False,
+    step: Optional[int] = None,
+    manifest: bool = True,
+):
     """Save a (possibly GSPMD-sharded) state dict WITHOUT gathering: every
     process writes its own shards (orbax OCDBT). ``async_save`` returns
-    immediately and serializes in the background (reference async save)."""
+    immediately and serializes in the background (reference async save).
+
+    Checksums are computed from the live arrays BEFORE the write starts, and
+    the manifest (commit marker) is written only after orbax finalizes — for
+    async saves, inside ``wait_until_finished()``."""
     arrays = _to_arrays(state_dict)
     path = os.path.abspath(path)
+    man = _build_manifest(arrays, step=step) if manifest else None
     old = None
     if os.path.exists(path):
         # keep the previous checkpoint until the new one lands (atomicity:
         # orbax writes tmp+rename, so a fresh path is safe; the old copy is
-        # parked aside and dropped only after a successful save)
+        # parked aside WITH its manifest and dropped only after a successful
+        # save — resume treats a committed .old as a valid fallback)
         old = path + ".old"
         shutil.rmtree(old, ignore_errors=True)
+        _remove_manifest(old)
         os.rename(path, old)
+        _move_manifest(path, old)
     ck = _ckpt(async_mode=async_save)
     try:
+        from ..fault import inject as _inject
+
+        _inject.check("ckpt.write", path=path)
         ck.save(path, arrays)
     except Exception:
         if old and not os.path.exists(path):
             os.rename(old, path)
+            _move_manifest(old, path)
         raise
-    if old and not async_save:
-        shutil.rmtree(old, ignore_errors=True)
-    # async: the .old backup is kept until the NEXT save parks it away — the
-    # background write may still fail/crash before commit, and the backup is
-    # the only good copy until then
+    # the .old backup is kept until the new checkpoint is COMMITTED: the
+    # finalize (background atomic rename) may still fail/crash, and the
+    # backup is the only good copy until the manifest lands. Async saves
+    # keep it until the NEXT save parks it away.
     if async_save:
-        return ck  # caller may ck.wait_until_finished()
+        return _PendingSave(ck, man, path, old)
     # StandardCheckpointer finalizes (atomic rename) in the background even
-    # on the "sync" path — block so the artifact is durable on return
+    # on the "sync" path — block so the artifact is durable, then commit
     getattr(ck, "wait_until_finished", lambda: None)()
+    if man is not None:
+        _write_manifest(man, path)
+    _prof().counter_inc("ckpt_saves")
+    if old:
+        shutil.rmtree(old, ignore_errors=True)
+        _remove_manifest(old)
     return None
 
 
-def load_state_dict(state_dict: Dict[str, Any], path: str):
+def load_state_dict(
+    state_dict: Dict[str, Any],
+    path: str,
+    strict: bool = True,
+    verify: Optional[bool] = None,
+):
     """Restore into ``state_dict`` in place, re-placing each array onto the
-    destination tensor's current sharding."""
+    destination tensor's current sharding.
+
+    ``strict`` (default): raise CheckpointError listing keys missing from the
+    checkpoint and unexpected keys present only in the checkpoint, instead of
+    silently skipping them. ``strict=False`` keeps the old skip behavior.
+
+    ``verify``: recompute per-leaf checksums of the restored arrays against
+    the manifest. Default (None): verify when a manifest exists and
+    ``FLAGS_ckpt_verify_on_load`` is set; legacy manifest-less checkpoints
+    load unverified."""
     import orbax.checkpoint as ocp
 
+    path = os.path.abspath(path)
     ck = ocp.StandardCheckpointer()
-    arrays = ck.restore(os.path.abspath(path))
+    arrays = _own_leaves(ck.restore(path))
+    man = read_manifest(path)
+    if verify is None:
+        verify = man is not None and bool(_flags.flag("FLAGS_ckpt_verify_on_load", True))
+    if verify:
+        if man is None:
+            raise CheckpointError(f"checkpoint {path}: no manifest to verify against")
+        if not man.get("committed"):
+            raise CheckpointError(f"checkpoint {path}: manifest present but not committed")
+        _verify_against_manifest(arrays, man, path)
+    if strict:
+        exact, objroots = _tree_keys(state_dict)
+        have = {k for k, _ in _flat_keys(arrays)}
+
+        def under_obj(key):
+            return any(key == r or key.startswith(r + "/") for r in objroots)
+
+        missing = sorted(k for k in exact if k not in have)
+        missing += sorted(
+            r for r in objroots
+            if not any(h == r or h.startswith(r + "/") for h in have)
+        )
+        unexpected = sorted(h for h in have if h not in exact and not under_obj(h))
+        if missing or unexpected:
+            raise CheckpointError(
+                f"checkpoint {path}: state mismatch — missing keys "
+                f"{missing or '[]'}, unexpected keys {unexpected or '[]'} "
+                f"(pass strict=False to skip silently)"
+            )
     _apply_arrays(state_dict, arrays)
     return state_dict
 
 
 class AutoCheckpoint:
-    """Periodic checkpoint + automatic resume (reference
+    """Periodic checkpoint + automatic CRASH-SAFE resume (reference
     auto_checkpoint.py:71 ``train_epoch_range``): call ``maybe_save`` each
-    step; on restart, ``resume`` returns the last completed step (or -1)."""
+    step; on restart, ``resume`` returns the last completed step whose
+    checkpoint verifies (or -1). A failed periodic save is retried with
+    backoff, then logged and skipped — training outlives transient
+    checkpoint I/O errors, and resume falls back to the previous good copy."""
 
-    def __init__(self, save_dir: str, interval_steps: int = 100, keep_last: int = 2, async_save: bool = False):
+    def __init__(
+        self,
+        save_dir: str,
+        interval_steps: int = 100,
+        keep_last: int = 2,
+        async_save: bool = False,
+        save_retries: int = 2,
+    ):
         self.save_dir = os.path.abspath(save_dir)
         self.interval = int(interval_steps)
         self.keep_last = keep_last
         self.async_save = async_save
+        self.save_retries = int(save_retries)
         self._pending = None
         os.makedirs(self.save_dir, exist_ok=True)
 
@@ -122,56 +416,144 @@ class AutoCheckpoint:
     def _step_path(self, step):
         return os.path.join(self.save_dir, f"step_{step}")
 
-    def maybe_save(self, step: int, state_dict: Dict[str, Any]):
+    @staticmethod
+    def _parse_step_dir(d: str) -> Optional[Tuple[int, bool]]:
+        """``step_7`` -> (7, True); ``step_7.old`` -> (7, False); orbax tmp
+        litter and anything else -> None."""
+        if not d.startswith("step_"):
+            return None
+        rest = d[len("step_"):]
+        if rest.isdigit():
+            return int(rest), True
+        if rest.endswith(".old") and rest[: -len(".old")].isdigit():
+            return int(rest[: -len(".old")]), False
+        return None
+
+    def _candidates(self) -> List[Tuple[int, bool, str]]:
+        """(step, is_primary, path) for every step dir incl. .old backups,
+        newest first, primary before backup at the same step."""
+        out = []
+        for d in os.listdir(self.save_dir):
+            parsed = self._parse_step_dir(d)
+            if parsed is not None and os.path.isdir(os.path.join(self.save_dir, d)):
+                step, primary = parsed
+                out.append((step, primary, os.path.join(self.save_dir, d)))
+        out.sort(key=lambda t: (t[0], t[1]), reverse=True)
+        return out
+
+    def _is_committed(self, path: str) -> bool:
+        man = read_manifest(path)
+        return bool(man and man.get("committed"))
+
+    def _step_committed(self, step: int) -> bool:
+        """Either the primary dir or its parked .old backup is committed —
+        resume can use both, so GC must protect both."""
+        return (
+            self._is_committed(self._step_path(step))
+            or self._is_committed(self._step_path(step) + ".old")
+        )
+
+    def maybe_save(self, step: int, state_dict: Dict[str, Any]) -> bool:
         if step == 0 or step % self.interval:
             # step 0 is the untrained state — saving it would also age out a
             # useful checkpoint one interval earlier under keep_last
             return False
-        if self._pending is not None:
-            self._pending.wait_until_finished()
-            self._pending = None
-        self._pending = save_state_dict(
-            state_dict, self._step_path(step), async_save=self.async_save
-        )
+        return self.save_now(step, state_dict)
+
+    def save_now(self, step: int, state_dict: Dict[str, Any], sync: bool = False) -> bool:
+        """Save unconditionally (``sync=True`` forces a synchronous save even
+        in async mode — the preemption-drain path). Retries transient I/O
+        failures with backoff; a save that still fails is logged and skipped
+        (resume falls back to the previous verified checkpoint)."""
+        from ..fault.retry import retry_call
+
+        try:
+            # a failed async background write from the PREVIOUS save surfaces
+            # here — log it like any other lost save instead of killing the
+            # training loop (resume falls back to the last committed copy)
+            self.wait()
+        except Exception as e:
+            _prof().counter_inc("ckpt_save_failures")
+            warnings.warn(f"previous async checkpoint save failed (skipped): {e!r}")
+        try:
+            pend = retry_call(
+                save_state_dict,
+                state_dict,
+                self._step_path(step),
+                async_save=self.async_save and not sync,
+                step=step,
+                retries=self.save_retries,
+                base_delay=0.05,
+            )
+        except Exception as e:
+            _prof().counter_inc("ckpt_save_failures")
+            warnings.warn(f"checkpoint save at step {step} failed (skipped): {e!r}")
+            return False
+        self._pending = pend
         with open(self._meta_path(), "w") as f:
+            # legacy pointer only — resume verifies manifests instead
             json.dump({"step": step, "ts": time.time()}, f)
-        # GC old checkpoints (skip orbax tmp dirs mid-rename)
-        steps = sorted(
-            int(d.split("_")[1])
-            for d in os.listdir(self.save_dir)
-            if d.startswith("step_") and d.split("_")[1].isdigit()
-        )
-        for s in steps[: -self.keep_last]:
-            shutil.rmtree(self._step_path(s), ignore_errors=True)
+        self._gc()
         return True
 
+    def _gc(self):
+        """Drop old checkpoints, but NEVER the newest verified-good copy —
+        if the last ``keep_last`` saves all turn out corrupt, the verified
+        one is the only resumable state left."""
+        steps = sorted({s for s, _primary, _ in self._candidates()})
+        # keep_last=0 historically meant "keep everything" (old GC sliced
+        # steps[:-0] == [])
+        keep = set(steps[-self.keep_last:]) if self.keep_last else set(steps)
+        committed = [s for s in steps if self._step_committed(s)]
+        if committed:
+            keep.add(committed[-1])
+        for s in steps:
+            if s in keep:
+                continue
+            for path in (self._step_path(s), self._step_path(s) + ".old"):
+                shutil.rmtree(path, ignore_errors=True)
+                _remove_manifest(path)
+
     def resume(self, state_dict: Dict[str, Any]) -> int:
-        """Load the newest FINALIZED checkpoint into state_dict; returns its
-        step or -1. Falls back to older checkpoints when the latest save was
-        interrupted mid-write (latest.json can be ahead of the async
-        finalize)."""
+        """Load the newest VERIFIED checkpoint into state_dict; returns its
+        step or -1. Walks candidates newest-first — primary dirs then their
+        ``.old`` backups — skipping uncommitted (mid-write crash), corrupt
+        (checksum mismatch) and unreadable checkpoints. Does NOT trust
+        latest.json: the pointer can be ahead of the async finalize."""
         if not os.path.isdir(self.save_dir):
             return -1
-        steps = sorted(
-            (
-                int(d.split("_")[1])
-                for d in os.listdir(self.save_dir)
-                if d.startswith("step_") and d.split("_")[1].isdigit()
-            ),
-            reverse=True,
-        )
-        for step in steps:
+        fell_back = 0
+        for step, _primary, path in self._candidates():
+            man = read_manifest(path)
+            if man is not None and not man.get("committed"):
+                fell_back += 1
+                continue
             try:
-                load_state_dict(state_dict, self._step_path(step))
-                return step
+                # legacy checkpoints (no manifest) load unverified; manifest
+                # checkpoints verify checksums end-to-end. strict=False: a
+                # tree mismatch here means the USER's model changed — every
+                # older checkpoint shares the tree, so walking back would
+                # only silently discard all progress instead of restoring
+                # what still matches (the pre-manifest behavior).
+                load_state_dict(state_dict, path, strict=False, verify=man is not None)
             except Exception:
-                continue  # incomplete/corrupt dir: try the next-oldest
+                fell_back += 1
+                continue
+            if fell_back:
+                _prof().counter_inc("ckpt_resume_fallbacks", fell_back)
+            return step
+        if fell_back:
+            _prof().counter_inc("ckpt_resume_fallbacks", fell_back)
         return -1
 
     def wait(self):
         if self._pending is not None:
-            self._pending.wait_until_finished()
-            self._pending = None
+            try:
+                self._pending.wait_until_finished()
+            finally:
+                # even on failure, drop the handle: re-raising the same error
+                # from every later save would wedge the loop permanently
+                self._pending = None
 
 
 def engine_state_dict(engine) -> Dict[str, Any]:
@@ -214,6 +596,6 @@ def engine_load_state_dict(engine, path) -> None:
 
 
 __all__ = [
-    "save_state_dict", "load_state_dict", "AutoCheckpoint",
-    "engine_state_dict", "engine_load_state_dict",
+    "save_state_dict", "load_state_dict", "AutoCheckpoint", "CheckpointError",
+    "read_manifest", "engine_state_dict", "engine_load_state_dict",
 ]

@@ -14,6 +14,10 @@ import time
 from enum import Enum
 from typing import Callable, Dict, List, Optional
 
+from ....fault import inject as _inject
+from ....fault.preemption import RESUMABLE_EXIT_CODE
+from ....fault.retry import retry_call
+
 
 class ElasticStatus(Enum):
     COMPLETED = "completed"
@@ -42,6 +46,8 @@ class ElasticManager:
         timeout: float = 5.0,
         min_np: Optional[int] = None,
         max_np: Optional[int] = None,
+        store_retries: int = 3,
+        retry_base_delay: float = 0.05,
     ):
         self.store = store
         self.np_target = int(np_target)
@@ -50,9 +56,28 @@ class ElasticManager:
         self.worker_id = worker_id
         self.heartbeat_interval = float(heartbeat_interval)
         self.timeout = float(timeout)
+        self.store_retries = int(store_retries)
+        self.retry_base_delay = float(retry_base_delay)
         self._hb_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._last_world: Optional[List[str]] = None
+
+    def _store_op(self, fn, *args):
+        """Every store round-trip goes through the shared retry-with-backoff
+        helper (fault/retry.py) behind the ``store.op`` injection point — one
+        transient TCPStore error must not mark a worker dead or kill the
+        heartbeat thread."""
+
+        def op():
+            _inject.check("store.op")
+            return fn(*args)
+
+        return retry_call(
+            op,
+            retries=self.store_retries,
+            base_delay=self.retry_base_delay,
+            exceptions=(OSError, ConnectionError, TimeoutError, RuntimeError),
+        )
 
     # -- worker side -------------------------------------------------------
     def _hb_key(self, wid):
@@ -62,7 +87,7 @@ class ElasticManager:
         """Join the membership and start heartbeating (reference
         collective.py worker register + manager heartbeat thread)."""
         assert self.worker_id is not None, "worker_id required to register"
-        self.store.add(f"{self.PREFIX}/registered", 1)
+        self._store_op(self.store.add, f"{self.PREFIX}/registered", 1)
         self._beat()
         self._stop.clear()
         self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
@@ -70,14 +95,23 @@ class ElasticManager:
         return self
 
     def _beat(self):
-        self.store.set(self._hb_key(self.worker_id), json.dumps({"ts": time.time()}))
+        self._store_op(
+            self.store.set, self._hb_key(self.worker_id), json.dumps({"ts": time.time()})
+        )
 
     def _hb_loop(self):
+        # each _beat already retries with backoff; only give up (and let the
+        # watcher declare us dead) after several beats fail THROUGH their
+        # retries — i.e. the store is persistently gone, not hiccuping
+        consecutive = 0
         while not self._stop.wait(self.heartbeat_interval):
             try:
                 self._beat()
+                consecutive = 0
             except Exception:
-                return  # store gone: let the watcher declare us dead
+                consecutive += 1
+                if consecutive >= 3:
+                    return
 
     def deregister(self):
         self._stop.set()
@@ -94,7 +128,10 @@ class ElasticManager:
         now = time.time()
         alive = []
         for wid in known_ids:
-            raw = self.store.get(self._hb_key(wid))
+            try:
+                raw = self._store_op(self.store.get, self._hb_key(wid))
+            except Exception:
+                continue  # persistent store failure: treat as no heartbeat
             if not raw:
                 continue
             try:
@@ -131,14 +168,26 @@ class ElasticLauncher:
 
     def __init__(self, spawn_fn: Callable[[List[str]], Dict[str, object]],
                  manager: ElasticManager, watch_interval: float = 1.0,
-                 max_restarts: int = 3):
+                 max_restarts: int = 3, max_resumes: int = 32):
         self.spawn_fn = spawn_fn
         self.manager = manager
         self.watch_interval = watch_interval
         self.max_restarts = max_restarts
+        # preemption-drain exits (RESUMABLE_EXIT_CODE) are normal operations,
+        # not failures: they get their own (much larger) budget
+        self.max_resumes = max_resumes
+
+    def _respawn(self, procs, worker_ids):
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            p.wait()
+        return self.spawn_fn(worker_ids)
 
     def run(self, worker_ids: List[str]):
         restarts = 0
+        resumes = 0
         procs = self.spawn_fn(worker_ids)
         while True:
             time.sleep(self.watch_interval)
@@ -146,7 +195,25 @@ class ElasticLauncher:
             codes = {w: p.poll() for w, p in procs.items()}
             if all(c == 0 for c in codes.values()):
                 return 0
-            failed = [w for w, c in codes.items() if c not in (None, 0)]
+            failed = [
+                w for w, c in codes.items()
+                if c not in (None, 0, RESUMABLE_EXIT_CODE)
+            ]
+            if not failed and any(c == RESUMABLE_EXIT_CODE for c in codes.values()):
+                # clean preemption drain: the worker checkpointed and asked
+                # for a restart — relaunch without consuming the failure
+                # budget (resume comes from AutoCheckpoint on the worker side)
+                resumes += 1
+                if resumes > self.max_resumes:
+                    for p in procs.values():
+                        if p.poll() is None:
+                            p.terminate()
+                    raise RuntimeError(
+                        f"elastic: exceeded max_resumes={self.max_resumes} "
+                        "preemption restarts"
+                    )
+                procs = self._respawn(procs, worker_ids)
+                continue
             status = self.manager.watch(worker_ids)
             if failed or status in (ElasticStatus.RESTART, ElasticStatus.ERROR):
                 restarts += 1
@@ -157,9 +224,4 @@ class ElasticLauncher:
                     raise RuntimeError(
                         f"elastic: exceeded max_restarts={self.max_restarts}; failed={failed}"
                     )
-                for p in procs.values():
-                    if p.poll() is None:
-                        p.terminate()
-                for p in procs.values():
-                    p.wait()
-                procs = self.spawn_fn(worker_ids)
+                procs = self._respawn(procs, worker_ids)

@@ -106,6 +106,7 @@ def launch(
     log_dir: Optional[str] = None,
     elastic: bool = False,
     max_restarts: int = 3,
+    max_resumes: int = 32,
     hosts=None,
 ):
     """Launch this node's workers per the cluster topology; supervise them.
@@ -171,15 +172,33 @@ def launch(
         manager = ElasticManager(store, cluster.world_size, timeout=10.0)
         launcher = ElasticLauncher(
             lambda ids: spawn_all(ids, _elastic_port=elastic_port),
-            manager, max_restarts=max_restarts,
+            manager, max_restarts=max_restarts, max_resumes=max_resumes,
         )
         return launcher.run([f"w{t.rank}" for t in pod.trainers])
 
+    # Non-elastic supervision still honors the preemption-drain contract: a
+    # worker that exits with RESUMABLE_EXIT_CODE checkpointed cleanly and
+    # wants a restart (it resumes from AutoCheckpoint), so respawn instead of
+    # failing the job.
+    from ..fault.preemption import RESUMABLE_EXIT_CODE
+
+    resumes = 0
     procs = spawn_all()
-    codes = {w: p.wait() for w, p in procs.items()}
-    if any(codes.values()):
-        raise RuntimeError(f"workers exited with codes {codes}")
-    return 0
+    while True:
+        codes = {w: p.wait() for w, p in procs.items()}
+        if any(c not in (0, RESUMABLE_EXIT_CODE) for c in codes.values()):
+            raise RuntimeError(f"workers exited with codes {codes}")
+        if all(c == 0 for c in codes.values()):
+            return 0
+        # preemption drains are normal operations, not failures: same
+        # separate (larger) budget as ElasticLauncher.max_resumes
+        resumes += 1
+        if resumes > max_resumes:
+            raise RuntimeError(
+                f"workers preempted more than max_resumes={max_resumes} "
+                f"times (codes {codes})"
+            )
+        procs = spawn_all()
 
 
 def main():

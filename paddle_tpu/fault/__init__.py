@@ -1,0 +1,32 @@
+"""Fault tolerance — injection, retry, preemption drain.
+
+The reference stack survives fleet conditions with layered machinery
+(``FLAGS_check_nan_inf`` op scans, ``auto_checkpoint.py`` automatic resume,
+``fleet/elastic/manager.py`` fault watch/relaunch) but has no systematic
+fault-injection framework (SURVEY.md §2.4). This package is the TPU-native
+fault layer that goes further:
+
+* :mod:`~paddle_tpu.fault.inject` — deterministic, env/flag-addressable
+  injection points (store op failure, checkpoint write failure, SIGTERM at
+  step k, NaN into a named op's output) threaded through
+  checkpoint/elastic/lazy, so crash-at-any-point behavior is testable.
+* :mod:`~paddle_tpu.fault.retry` — shared retry-with-backoff helper wrapped
+  around TCPStore ops, elastic heartbeats and checkpoint I/O; one transient
+  store error no longer silently marks a worker dead.
+* :mod:`~paddle_tpu.fault.preemption` — ``PreemptionGuard``: SIGTERM/SIGINT
+  handlers that drain the pending lazy graph, force a final synchronous
+  checkpoint and exit with :data:`RESUMABLE_EXIT_CODE`; the launcher and
+  elastic supervisor treat that code as a clean restart.
+"""
+from __future__ import annotations
+
+from . import inject  # noqa: F401  (arms from PADDLE_FAULT_INJECT at import)
+from . import retry  # noqa: F401
+from .inject import InjectedFault  # noqa: F401
+from .preemption import PreemptionGuard, RESUMABLE_EXIT_CODE  # noqa: F401
+from .retry import retry_call, retrying  # noqa: F401
+
+__all__ = [
+    "inject", "retry", "InjectedFault", "PreemptionGuard",
+    "RESUMABLE_EXIT_CODE", "retry_call", "retrying",
+]

@@ -1,0 +1,235 @@
+"""Deterministic fault injection.
+
+Every injection point has a stable name (see :data:`POINTS`) and is consulted
+only when the framework is armed, so the production hot path pays one
+attribute check. Points are addressable from the environment
+(``PADDLE_FAULT_INJECT``) or programmatically (:func:`arm`), which makes
+crash-at-any-point resume testable without patching internals — the gap
+SURVEY.md flags even in the reference stack ("no systematic fault-injection
+framework").
+
+Spec grammar (env var or :func:`arm` string form)::
+
+    point[:k=v[,k=v...]][;point2:...]
+
+    PADDLE_FAULT_INJECT="ckpt.write:at=2,times=4;preempt.sigterm:step=3"
+
+Keys (all optional; values are ints except ``op``):
+
+* ``at=N``    — fire on the Nth matching call of this point (1-based).
+* ``from=N``  — fire on every matching call from the Nth on (persistent
+  failures that must defeat the retry helper).
+* ``step=K``  — fire when the call context carries ``step == K``.
+* ``op=NAME`` — only calls whose context carries ``op == NAME`` match.
+* ``call=N``  — with ``op=``: the Nth call of that op (alias of ``at``).
+* ``times=M`` — fire at most M times total (default: unlimited).
+
+Failure-type points (``store.op``, ``ckpt.write``) raise
+:class:`InjectedFault` (an ``OSError``, so the shared retry helper treats it
+as transient); ``preempt.sigterm`` delivers a real SIGTERM;
+``tensor.nan`` overwrites the first element of the named op's output with
+NaN (threaded through eager and lazy dispatch).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+# Registered injection point names -> where they are threaded. The tripwire
+# test in tests/test_fault_tolerance.py asserts every name here is exercised.
+POINTS: Dict[str, str] = {
+    "store.op": "TCPStore operations in fleet/elastic (set/get/add)",
+    "ckpt.write": "distributed/checkpoint.py save_state_dict write path",
+    "preempt.sigterm": "PreemptionGuard.check(step=k) — SIGTERM at step k",
+    "tensor.nan": "core/dispatch.py eager_call — NaN into a named op's output",
+}
+
+
+class InjectedFault(OSError):
+    """Raised by failure-type injection points. Subclasses OSError so the
+    shared retry helper classifies it as transient (tests control persistence
+    via ``times=``)."""
+
+    def __init__(self, point: str, ctx: Optional[dict] = None):
+        super().__init__(f"injected fault at '{point}' (ctx={ctx or {}})")
+        self.point = point
+        self.ctx = dict(ctx or {})
+
+
+_lock = threading.Lock()
+_armed = False
+_active: Dict[str, dict] = {}
+_calls: Dict[str, int] = {}
+_fired: Dict[str, int] = {}
+_exercised: set = set()  # every point that ever fired in this process
+
+
+def _parse_spec(spec: str) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, raw = part.partition(":")
+        point = point.strip()
+        cfg: dict = {}
+        for kv in raw.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            cfg[k] = v.strip() if k == "op" else int(v)
+        out[point] = cfg
+    return out
+
+
+def _install_dispatch_hook(mod):
+    # dispatch checks a module attribute instead of importing us per op call
+    try:
+        from ..core import dispatch
+
+        dispatch._fault_inject = mod
+    except Exception:
+        pass
+
+
+def arm(spec) -> None:
+    """Arm injection. ``spec`` is either the string grammar above or a dict
+    ``{point: {key: value}}``. Unknown point names raise KeyError (typos in a
+    fault spec must not silently disable the fault)."""
+    global _armed
+    cfgs = _parse_spec(spec) if isinstance(spec, str) else {
+        k: dict(v) for k, v in spec.items()
+    }
+    for point in cfgs:
+        if point not in POINTS:
+            import difflib
+
+            hint = difflib.get_close_matches(point, POINTS, n=1)
+            raise KeyError(
+                f"unknown injection point {point!r}"
+                + (f"; did you mean {hint[0]!r}?" if hint else f"; known: {sorted(POINTS)}")
+            )
+    with _lock:
+        _active.clear()
+        _active.update(cfgs)
+        _calls.clear()
+        _fired.clear()
+        _armed = bool(_active)
+    import sys
+
+    _install_dispatch_hook(sys.modules[__name__] if _armed else None)
+
+
+def disarm() -> None:
+    """Disarm all injection points (counters reset)."""
+    global _armed
+    with _lock:
+        _active.clear()
+        _calls.clear()
+        _fired.clear()
+        _armed = False
+    _install_dispatch_hook(None)
+
+
+def armed() -> bool:
+    return _armed
+
+
+def should_fire(point: str, step: Optional[int] = None, op: Optional[str] = None) -> bool:
+    """Deterministically decide whether ``point`` fires for this call.
+    Counts only calls that pass the ``op=`` filter, so ``at=N`` means "the
+    Nth call of that op" regardless of unrelated traffic."""
+    if point not in POINTS:
+        raise KeyError(f"unknown injection point {point!r}; known: {sorted(POINTS)}")
+    if not _armed:
+        return False
+    with _lock:
+        cfg = _active.get(point)
+        if cfg is None:
+            return False
+        if "op" in cfg and op != cfg["op"]:
+            return False
+        n = _calls.get(point, 0) + 1
+        _calls[point] = n
+        at = cfg.get("at", cfg.get("call"))
+        if "step" in cfg:
+            fire = step is not None and int(step) == cfg["step"]
+        elif at is not None:
+            fire = n == at
+        elif "from" in cfg:
+            fire = n >= cfg["from"]
+        else:
+            fire = True
+        if fire:
+            times = cfg.get("times")
+            if times is not None and _fired.get(point, 0) >= times:
+                return False
+            _fired[point] = _fired.get(point, 0) + 1
+            _exercised.add(point)
+        return fire
+
+
+def check(point: str, **ctx) -> None:
+    """Raise :class:`InjectedFault` when ``point`` fires (failure-type call
+    sites: store ops, checkpoint writes)."""
+    if should_fire(point, step=ctx.get("step"), op=ctx.get("op")):
+        raise InjectedFault(point, ctx)
+
+
+def exercised() -> set:
+    """Point names that have fired at least once in this process."""
+    return set(_exercised)
+
+
+def fired_counts() -> Dict[str, int]:
+    with _lock:
+        return dict(_fired)
+
+
+# -- tensor.nan payload ------------------------------------------------------
+def poison_first_nan(res) -> bool:
+    """Overwrite the first element of the first floating-point output of an
+    op result (Tensor or list of Tensors) with NaN. Lazy-aware: under the
+    lazy engine the poison is recorded as a graph node so the NaN is born
+    INSIDE the fused flush — exactly the case the lazy-mode
+    FLAGS_check_nan_inf guard exists for."""
+    import jax.numpy as jnp
+
+    from ..core import lazy as lazy_mod
+
+    def pz(x):
+        return jnp.reshape(jnp.ravel(x).at[0].set(jnp.nan), jnp.shape(x))
+
+    ts = res if isinstance(res, (list, tuple)) else [res]
+    for t in ts:
+        d = getattr(t, "_data", None)
+        if d is None or not hasattr(d, "dtype"):
+            continue
+        if not jnp.issubdtype(d.dtype, jnp.floating):
+            continue
+        if lazy_mod.is_lazy(d):
+            (out,), _ = lazy_mod.record(
+                "fault_inject_nan", pz, [d], key=("fault_inject_nan",)
+            )
+            t._data = out
+        else:
+            t._data = pz(d)
+        return True
+    return False
+
+
+def _arm_from_env() -> None:
+    spec = os.environ.get("PADDLE_FAULT_INJECT", "").strip()
+    if spec:
+        arm(spec)
+
+
+_arm_from_env()
+
+__all__ = [
+    "POINTS", "InjectedFault", "arm", "disarm", "armed", "should_fire",
+    "check", "exercised", "fired_counts", "poison_first_nan",
+]

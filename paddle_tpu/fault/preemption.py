@@ -1,0 +1,131 @@
+"""Preemption drain — survive SIGTERM with a resumable exit.
+
+Reference parity: elastic training relies on the scheduler sending SIGTERM
+before reclaiming a node (``fleet/elastic/manager.py`` watch/relaunch). Under
+the lazy engine a naive handler is worse than useless: the pending graph
+holds un-executed backward+optimizer work and donated input buffers, so dying
+mid-flush loses a partially-applied step. ``PreemptionGuard`` drains the
+pending lazy graph at a step boundary, forces a final synchronous checkpoint,
+and exits with :data:`RESUMABLE_EXIT_CODE` — which the launcher and the
+elastic supervisor treat as a clean restart rather than a failure.
+"""
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import Callable, Optional
+
+from .retry import _counter
+
+# EX_TEMPFAIL: "temporary failure, retry later". Workers that drained cleanly
+# exit with this; supervisors relaunch without consuming the failure budget.
+RESUMABLE_EXIT_CODE = 75
+
+
+class PreemptionGuard:
+    """Install SIGTERM/SIGINT handlers; drain + checkpoint + resumable exit.
+
+    Usage::
+
+        ac = AutoCheckpoint(save_dir, interval_steps=100)
+        with PreemptionGuard(checkpoint=ac) as guard:
+            for step in range(start, steps):
+                loss = train_step(...)
+                ac.maybe_save(step, state)
+                guard.check(step, state)   # drains + exits if preempted
+
+    The handler only sets a flag — all real work (lazy flush, checkpoint
+    write, exit) happens at the next ``check()`` call, i.e. at a step
+    boundary where the state dict is consistent.
+    """
+
+    def __init__(
+        self,
+        checkpoint=None,
+        signals=(signal.SIGTERM, signal.SIGINT),
+        exit_code: int = RESUMABLE_EXIT_CODE,
+        exit_fn: Callable[[int], None] = sys.exit,
+    ):
+        self.checkpoint = checkpoint
+        self.signals = tuple(signals)
+        self.exit_code = int(exit_code)
+        self.exit_fn = exit_fn
+        self._preempted = False
+        self._signum: Optional[int] = None
+        self._prev_handlers: dict = {}
+        self._installed = False
+
+    # -- signal plumbing ---------------------------------------------------
+    def _handler(self, signum, frame):
+        self._preempted = True
+        self._signum = signum
+
+    def install(self) -> "PreemptionGuard":
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            # signal.signal raises off the main thread; degrade to a no-op
+            # guard (check() still works when preempt() is called directly)
+            return self
+        for s in self.signals:
+            try:
+                self._prev_handlers[s] = signal.signal(s, self._handler)
+            except (ValueError, OSError):
+                pass
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        for s, h in self._prev_handlers.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers.clear()
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- step-boundary API -------------------------------------------------
+    @property
+    def preempted(self) -> bool:
+        return self._preempted
+
+    def preempt(self) -> None:
+        """Mark the guard preempted without a real signal (tests, schedulers
+        with their own notification channel)."""
+        self._preempted = True
+
+    def check(self, step: int, state_dict=None) -> bool:
+        """Call once per completed step ``step``. Fires the
+        ``preempt.sigterm`` injection point, and if a preemption signal has
+        arrived: drains the lazy graph, writes a final synchronous checkpoint
+        of ``state_dict`` at ``step``, and exits with the resumable code."""
+        from . import inject
+
+        if inject._armed and inject.should_fire("preempt.sigterm", step=step):
+            signal.raise_signal(signal.SIGTERM)  # runs our handler inline
+        if not self._preempted:
+            return False
+        self.drain(step, state_dict)
+        self.exit_fn(self.exit_code)
+        return True  # only reached when exit_fn returns (tests)
+
+    def drain(self, step: Optional[int] = None, state_dict=None) -> None:
+        """Flush the pending lazy graph and force a final synchronous
+        checkpoint (bypasses the save interval and async mode)."""
+        from ..core import lazy
+
+        lazy.flush()
+        _counter("preemption_drains")
+        if self.checkpoint is not None and state_dict is not None and step is not None and step >= 0:
+            self.checkpoint.save_now(step, state_dict, sync=True)
+
+
+__all__ = ["PreemptionGuard", "RESUMABLE_EXIT_CODE"]

@@ -12,6 +12,16 @@ from typing import Dict
 
 _FLAGS: Dict[str, object] = {
     "FLAGS_check_nan_inf": False,          # reference operator.cc:1171 nan/inf scan
+    # Lazy-mode per-op nan/inf attribution (checkify-style): every flush is
+    # re-run unfused with every node output checked, so NaNs in fused-away
+    # dead intermediates are caught too and the first non-finite value is
+    # attributed to the op that produced it. ~2x compute — the reference's
+    # documented debug-mode cost. Only consulted when FLAGS_check_nan_inf
+    # is set.
+    "FLAGS_check_nan_inf_per_op": False,
+    # Verify checkpoint shard checksums against the manifest on load (skipped
+    # automatically for legacy checkpoints without a manifest).
+    "FLAGS_ckpt_verify_on_load": True,
     "FLAGS_cudnn_deterministic": False,
     "FLAGS_eager_delete_tensor_gb": 0.0,
     "FLAGS_allocator_strategy": "auto_growth",
@@ -46,8 +56,25 @@ for _k in list(_FLAGS):
             _FLAGS[_k] = v
 
 
+def register_flag(name: str, default):
+    """Register a new flag (plugins/tests). Registration is explicit so that
+    ``set_flags`` can reject typos instead of creating dead flags."""
+    _FLAGS.setdefault(name, default)
+
+
 def set_flags(flags: dict):
     for k, v in flags.items():
+        if k not in _FLAGS:
+            # A typo like FLAGS_chek_nan_inf would otherwise create a dead
+            # flag and silently disable the debug mode the user asked for.
+            import difflib
+
+            hint = difflib.get_close_matches(k, _FLAGS, n=1)
+            raise KeyError(
+                f"unknown flag {k!r}"
+                + (f"; did you mean {hint[0]!r}?" if hint else "")
+                + " (use framework.flags.register_flag to add new flags)"
+            )
         _FLAGS[k] = v
 
 

@@ -58,9 +58,16 @@ def counter_inc(name: str, n: int = 1):
 
 def counters() -> Dict[str, int]:
     """Snapshot of engine counters: ``lazy_flushes``, ``lazy_cache_hits``,
-    ``lazy_donated_buffers``, ``lazy_donation_fallbacks`` (always on), and
+    ``lazy_donated_buffers``, ``lazy_donation_fallbacks`` (always on),
     ``dispatch_fastkey_hits`` (per-op — only counted while the profiler is
-    running, to keep the dispatch hot path free of bookkeeping)."""
+    running, to keep the dispatch hot path free of bookkeeping), and the
+    fault-tolerance set: ``ckpt_saves`` / ``ckpt_save_failures`` /
+    ``ckpt_resume_fallbacks`` (crash-safe checkpointing),
+    ``preemption_drains`` (PreemptionGuard SIGTERM drains),
+    ``retry_attempts`` (fault/retry.py backoff retries), ``naninf_trips``
+    (lazy-mode FLAGS_check_nan_inf post-flush trips) and
+    ``naninf_donation_suppressed`` (flushes that skipped buffer donation to
+    keep pre-step state inspectable under the nan guard)."""
     return dict(_counters)
 
 

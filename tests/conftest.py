@@ -44,6 +44,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "smoke: fast cross-section of the suite (<60s total)"
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection / fault-tolerance tests (CPU-fast, tier-1)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

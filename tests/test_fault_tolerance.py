@@ -1,0 +1,451 @@
+"""Fault-tolerant training end to end.
+
+Proves the ISSUE-2 acceptance criteria on CPU:
+* crash/resume parity — SIGTERM (preemption drain) at step k, and separately
+  a failed checkpoint write, both resume from the last verified checkpoint
+  and reproduce the uninterrupted loss sequence bit-for-bit;
+* crash-safe checkpointing — manifest commit markers, checksum verification,
+  walk-back past uncommitted/corrupt checkpoints (incl. orbax tmp litter);
+* FLAGS_check_nan_inf under the lazy engine — raises within the step, names
+  the producing op in per-op mode, suppresses donation while armed;
+* the fault-injection harness itself (deterministic firing, retry backoff),
+  with a tripwire asserting every registered injection point is exercised.
+"""
+import os
+import pathlib
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.core.lazy import is_lazy, lazy_guard
+from paddle_tpu.distributed.checkpoint import (
+    AutoCheckpoint, CheckpointError, load_state_dict, read_manifest,
+    save_state_dict,
+)
+from paddle_tpu.distributed.fleet.elastic import ElasticLauncher, ElasticManager
+from paddle_tpu.fault import (
+    InjectedFault, PreemptionGuard, RESUMABLE_EXIT_CODE, inject, retry_call,
+)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm_and_reset_flags():
+    yield
+    inject.disarm()
+    paddle.set_flags(
+        {"FLAGS_check_nan_inf": False, "FLAGS_check_nan_inf_per_op": False}
+    )
+
+
+# -- deterministic micro-training loop ---------------------------------------
+def _data_for(step):
+    rng = np.random.RandomState(1000 + step)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 1).astype(np.float32)
+    return x, y
+
+
+def _fresh_w():
+    w = paddle.to_tensor(np.full((4, 1), 0.5, np.float32))
+    w.stop_gradient = False
+    return w
+
+
+def _train_step(w, step, lr=0.1):
+    x, y = _data_for(step)
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+    loss = ((paddle.matmul(xt, w) - yt) ** 2).mean()
+    loss.backward()
+    w._set_data(w._data - lr * w.grad._data)
+    w.clear_grad()
+    return float(loss)  # materialization point: one lazy flush per step
+
+
+def _uninterrupted_losses(steps=6):
+    w = _fresh_w()
+    return [_train_step(w, s) for s in range(steps)]
+
+
+# -- acceptance: crash/resume parity -----------------------------------------
+class TestPreemptionResumeParity:
+    def test_sigterm_at_step_k_resumes_bit_for_bit(self, tmp_path):
+        ref = _uninterrupted_losses()
+
+        ckdir = str(tmp_path / "auto")
+        ac = AutoCheckpoint(ckdir, interval_steps=100)  # drain save only
+        inject.arm({"preempt.sigterm": {"step": 2}})
+        before = profiler.counters().get("preemption_drains", 0)
+        w = _fresh_w()
+        losses = []
+        with PreemptionGuard(checkpoint=ac) as guard:
+            with pytest.raises(SystemExit) as ei:
+                for step in range(6):
+                    losses.append(_train_step(w, step))
+                    guard.check(step, {"w": w})
+        assert ei.value.code == RESUMABLE_EXIT_CODE
+        assert profiler.counters()["preemption_drains"] == before + 1
+        inject.disarm()
+
+        # a fresh process would start here: resume from the drained checkpoint
+        w2 = _fresh_w()
+        start = AutoCheckpoint(ckdir).resume({"w": w2})
+        assert start == 2
+        for step in range(start + 1, 6):
+            losses.append(_train_step(w2, step))
+        assert losses == ref  # bit-for-bit on CPU
+
+    def test_failed_checkpoint_write_resumes_from_last_committed(self, tmp_path):
+        ref = _uninterrupted_losses()
+
+        ckdir = str(tmp_path / "auto")
+        ac = AutoCheckpoint(ckdir, interval_steps=2, save_retries=2)
+        # the SECOND checkpoint write (step 4) fails persistently — every
+        # retry attempt fires too, so the save is genuinely lost
+        inject.arm({"ckpt.write": {"from": 2}})
+        w = _fresh_w()
+        w_at_2 = None
+        for step in range(6):
+            _train_step(w, step)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                ac.maybe_save(step, {"w": w})
+            if step == 2:
+                w_at_2 = w.numpy().copy()
+        ac.wait()
+        inject.disarm()
+        assert profiler.counters().get("retry_attempts", 0) >= 2
+
+        # litter the save dir the way a mid-save kill does: an orbax tmp dir
+        # and an uncommitted checkpoint dir (data present, no manifest commit)
+        os.makedirs(os.path.join(ckdir, "step_6.orbax-checkpoint-tmp-123"))
+        os.makedirs(os.path.join(ckdir, "step_6"))
+        with open(os.path.join(ckdir, "step_6", "garbage"), "w") as f:
+            f.write("partial write")
+
+        w2 = _fresh_w()
+        before = profiler.counters().get("ckpt_resume_fallbacks", 0)
+        start = AutoCheckpoint(ckdir).resume({"w": w2})
+        assert start == 2  # step-4 save failed; step-6 litter skipped
+        assert profiler.counters()["ckpt_resume_fallbacks"] > before
+        np.testing.assert_array_equal(w2.numpy(), w_at_2)  # bit-identical
+
+        losses = []
+        for step in range(start + 1, 6):
+            losses.append(_train_step(w2, step))
+        assert losses == ref[start + 1:]
+
+
+# -- crash-safe checkpointing -------------------------------------------------
+class TestManifest:
+    def test_save_writes_committed_manifest(self, tmp_path):
+        p = str(tmp_path / "ck")
+        w = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        save_state_dict({"w": w, "nested": {"b": w}}, p, step=7)
+        man = read_manifest(p)
+        assert man["committed"] is True and man["step"] == 7
+        assert set(man["tree"]) == {"w", "nested/b"}
+        assert man["tree"]["w"]["crc32"] is not None
+
+    def test_checksum_mismatch_detected_on_load(self, tmp_path):
+        import json
+
+        p = str(tmp_path / "ck")
+        w = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        save_state_dict({"w": w}, p)
+        man = read_manifest(p)
+        man["tree"]["w"]["crc32"] ^= 0xDEAD
+        with open(p + ".manifest.json", "w") as f:
+            json.dump(man, f)
+        with pytest.raises(CheckpointError, match="checksum mismatch"):
+            load_state_dict({"w": paddle.to_tensor(np.zeros(4, np.float32))}, p)
+
+    def test_resume_skips_uncommitted_manifest(self, tmp_path):
+        import json
+
+        ac = AutoCheckpoint(str(tmp_path / "auto"), interval_steps=1, keep_last=5)
+        w = paddle.to_tensor(np.zeros(3, np.float32))
+        for step in range(1, 4):
+            w._set_data(w._data + 1)
+            ac.maybe_save(step, {"w": w})
+        ac.wait()
+        # step_3 committed but marked mid-write: resume must fall back to 2
+        man = read_manifest(ac._step_path(3))
+        man["committed"] = False
+        with open(ac._step_path(3) + ".manifest.json", "w") as f:
+            json.dump(man, f)
+        w2 = paddle.to_tensor(np.zeros(3, np.float32))
+        assert ac.resume({"w": w2}) == 2
+        np.testing.assert_array_equal(w2.numpy(), np.full(3, 2.0))
+
+    def test_gc_never_deletes_last_verified_checkpoint(self, tmp_path):
+        # async mode: the manifest commits only at wait_until_finished, so at
+        # GC time the newest save is still UNVERIFIED — with keep_last=1 a
+        # naive GC would delete step_1, the only good copy
+        ac = AutoCheckpoint(
+            str(tmp_path / "auto"), interval_steps=1, keep_last=1, async_save=True
+        )
+        w = paddle.to_tensor(np.zeros(2, np.float32))
+        w._set_data((w + 1.0)._data)
+        ac.maybe_save(1, {"w": w})
+        ac.wait()  # step_1 committed
+        w._set_data((w + 1.0)._data)
+        ac.maybe_save(2, {"w": w})  # async: uncommitted until wait()
+        assert read_manifest(ac._step_path(2)) is None
+        assert os.path.isdir(ac._step_path(1))  # survived GC despite keep_last=1
+        w2 = paddle.to_tensor(np.zeros(2, np.float32))
+        assert AutoCheckpoint(str(tmp_path / "auto")).resume({"w": w2}) == 1
+        np.testing.assert_array_equal(w2.numpy(), np.full(2, 1.0))
+        ac.wait()  # commit lands: now step 2 is the resume target
+        w3 = paddle.to_tensor(np.zeros(2, np.float32))
+        assert AutoCheckpoint(str(tmp_path / "auto")).resume({"w": w3}) == 2
+
+    def test_object_tree_resume_restores_optimizer_state(self, tmp_path):
+        """{"model": model, "optimizer": opt} checkpoints as a tree and
+        resume restores Adam moments + step count — and the restored buffers
+        are jax-owned copies, so the post-resume lazy flush can DONATE them
+        without corruption (regression: orbax hands back TensorStore-backed
+        arrays; donating those made the first resumed steps read garbage)."""
+        from paddle_tpu import nn
+
+        paddle.seed(11)
+        model = nn.Sequential(nn.Linear(16, 8), nn.Tanh(), nn.Linear(8, 4))
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.01, parameters=model.parameters()
+        )
+        state = {"model": model, "optimizer": opt}
+        ac = AutoCheckpoint(str(tmp_path / "auto"), interval_steps=3)
+
+        def step_fn(step):
+            rng = np.random.RandomState(2000 + step)
+            xt = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+            yt = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+            loss = ((model(xt) - yt) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return float(loss)
+
+        ref = []
+        for step in range(6):
+            ref.append(step_fn(step))
+            ac.maybe_save(step, state)  # saves at step 3
+        ac.wait()
+        # rewind the SAME objects to the step-3 checkpoint and replay
+        assert ac.resume(state) == 3
+        assert int(opt._step_count) == 4  # Adam bias correction restored
+        replay = [step_fn(step) for step in range(4, 6)]
+        assert replay == ref[4:]  # bit-for-bit, with donation enabled
+
+    def test_load_strict_reports_missing_and_unexpected(self, tmp_path):
+        p = str(tmp_path / "ck")
+        w = paddle.to_tensor(np.ones(2, np.float32))
+        save_state_dict({"a": w, "b": w}, p)
+        tgt = {"a": paddle.to_tensor(np.zeros(2, np.float32)),
+               "c": paddle.to_tensor(np.zeros(2, np.float32))}
+        with pytest.raises(CheckpointError, match=r"missing keys \['c'\].*unexpected keys \['b'\]"):
+            load_state_dict(tgt, p)
+        # strict=False keeps the old skip-silently behavior
+        load_state_dict(tgt, p, strict=False)
+        np.testing.assert_array_equal(tgt["a"].numpy(), np.ones(2, np.float32))
+        np.testing.assert_array_equal(tgt["c"].numpy(), np.zeros(2, np.float32))
+
+
+# -- lazy-mode nan/inf guard --------------------------------------------------
+class TestLazyNanInfGuard:
+    def test_trips_at_flush_within_same_step(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        a = paddle.to_tensor(np.array([0.0], np.float32))
+        t = paddle.log(a - 1.0)
+        assert is_lazy(t._data)  # the op stayed recorded — fusion survives
+        before = profiler.counters().get("naninf_trips", 0)
+        with pytest.raises(FloatingPointError, match="log"):
+            t.numpy()
+        assert profiler.counters()["naninf_trips"] == before + 1
+
+    def test_per_op_mode_names_producing_op(self):
+        paddle.set_flags(
+            {"FLAGS_check_nan_inf": True, "FLAGS_check_nan_inf_per_op": True}
+        )
+        a = paddle.to_tensor(np.array([0.0], np.float32))
+        # NaN born at log, then consumed: only the downstream output is held
+        d = paddle.log(a - 1.0) * 2.0
+        with pytest.raises(FloatingPointError, match=r"'log'.*flat index 0"):
+            d.numpy()
+
+    def test_per_op_mode_catches_dead_intermediate_nan(self):
+        # a NaN born in an intermediate that is masked out of every live
+        # output is invisible to the (fusion-preserving) default scan, but
+        # per-op mode checks every node output on every flush
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        a = paddle.to_tensor(np.array([0.0], np.float32))
+        paddle.log(a - 1.0)  # result discarded: its node output is dead
+        out = a + 1.0
+        np.testing.assert_array_equal(out.numpy(), [1.0])  # default: clean
+        paddle.set_flags({"FLAGS_check_nan_inf_per_op": True})
+        paddle.log(a - 1.0)
+        out2 = a + 2.0
+        with pytest.raises(FloatingPointError, match="log"):
+            out2.numpy()
+
+    def test_donation_suppressed_while_armed(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        before = profiler.counters().get("naninf_donation_suppressed", 0)
+        w = paddle.to_tensor(np.ones(4, np.float32))
+        w._set_data((w + 1.0)._data)  # lazy rebind — the donation pattern
+        w.numpy()
+        assert profiler.counters().get("naninf_donation_suppressed", 0) > before
+
+    def test_eager_message_details(self):
+        with lazy_guard(False):
+            paddle.set_flags({"FLAGS_check_nan_inf": True})
+            a = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+            with pytest.raises(FloatingPointError) as ei:
+                paddle.log(a - 1.0)  # [-inf, nan] — raises at the call site
+            msg = str(ei.value)
+        assert "output 0" in msg and "shape=(2,)" in msg
+        assert "float32" in msg and "2 non-finite" in msg and "flat index 0" in msg
+
+    def test_nan_injection_into_named_op(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        inject.arm({"tensor.nan": {"op": "matmul", "call": 1}})
+        w = _fresh_w()
+        with pytest.raises(FloatingPointError):
+            _train_step(w, 0)
+
+
+# -- retry + elastic ----------------------------------------------------------
+class _FakeStore:
+    def __init__(self):
+        self.kv = {}
+        self.fail_next = 0
+
+    def _maybe_fail(self):
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise OSError("transient store error")
+
+    def set(self, k, v):
+        self._maybe_fail()
+        self.kv[k] = v
+
+    def get(self, k):
+        self._maybe_fail()
+        return self.kv.get(k)
+
+    def add(self, k, n=1):
+        self._maybe_fail()
+        self.kv[k] = self.kv.get(k, 0) + n
+        return self.kv[k]
+
+    def delete_key(self, k):
+        self.kv.pop(k, None)
+
+
+class _FakeProc:
+    def __init__(self, code):
+        self._code = code
+
+    def poll(self):
+        return self._code
+
+    def wait(self):
+        return self._code
+
+    def terminate(self):
+        pass
+
+
+class TestRetryAndElastic:
+    def test_retry_call_backoff_and_counter(self):
+        calls, slept = [], []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return 42
+
+        before = profiler.counters().get("retry_attempts", 0)
+        assert retry_call(flaky, retries=5, base_delay=0.01, sleep=slept.append) == 42
+        assert len(calls) == 3
+        assert slept == [0.01, 0.02]  # exponential backoff
+        assert profiler.counters()["retry_attempts"] == before + 2
+
+    def test_heartbeat_survives_transient_store_errors(self):
+        st = _FakeStore()
+        m = ElasticManager(st, 1, worker_id="w0", retry_base_delay=0.001)
+        st.fail_next = 2
+        m._beat()  # retried through both failures
+        assert m._hb_key("w0") in st.kv
+
+        # injected transient store failure (times=2): absorbed by retry
+        inject.arm({"store.op": {"times": 2}})
+        m._beat()
+        inject.disarm()
+
+        # persistent store failure defeats the retry budget
+        inject.arm({"store.op": {}})
+        with pytest.raises(InjectedFault):
+            m._beat()
+
+    def test_launcher_treats_resumable_exit_as_clean_restart(self):
+        spawns = []
+
+        def spawn_fn(ids):
+            code = RESUMABLE_EXIT_CODE if not spawns else 0
+            spawns.append(1)
+            return {w: _FakeProc(code) for w in ids}
+
+        mgr = ElasticManager(_FakeStore(), 1)
+        launcher = ElasticLauncher(spawn_fn, mgr, watch_interval=0.01)
+        assert launcher.run(["w0"]) == 0
+        assert len(spawns) == 2  # preempted generation + clean relaunch
+
+
+# -- flags + harness tripwires ------------------------------------------------
+class TestFlagsAndTripwire:
+    def test_unknown_flag_typo_raises_with_suggestion(self):
+        with pytest.raises(KeyError, match="FLAGS_check_nan_inf"):
+            paddle.set_flags({"FLAGS_chek_nan_inf": True})
+
+    def test_register_flag_then_set(self):
+        from paddle_tpu.framework import flags
+
+        flags.register_flag("FLAGS_test_fault_tolerance_custom", 1)
+        paddle.set_flags({"FLAGS_test_fault_tolerance_custom": 2})
+        assert flags.flag("FLAGS_test_fault_tolerance_custom") == 2
+
+    def test_unknown_injection_point_raises(self):
+        with pytest.raises(KeyError, match="ckpt.write"):
+            inject.arm({"ckpt.wrte": {}})
+
+    def test_spec_string_grammar(self):
+        inject.arm("ckpt.write:at=2,times=1;preempt.sigterm:step=3")
+        assert not inject.should_fire("ckpt.write")       # call 1
+        assert inject.should_fire("ckpt.write")           # call 2 == at
+        assert not inject.should_fire("preempt.sigterm", step=1)
+        assert inject.should_fire("preempt.sigterm", step=3)
+
+    def test_every_injection_point_is_exercised(self):
+        # tripwire: every registered point name must appear in this test
+        # module (beyond the POINTS registry itself) AND fire through its
+        # public mechanism — adding a point without a test breaks this.
+        src = pathlib.Path(__file__).read_text()
+        for point in inject.POINTS:
+            assert src.count(point) >= 1, f"injection point {point!r} has no test"
+        for point in inject.POINTS:
+            inject.arm({point: {}})
+            try:
+                if point in ("store.op", "ckpt.write"):
+                    with pytest.raises(InjectedFault):
+                        inject.check(point)
+                else:
+                    assert inject.should_fire(point, step=0, op="any")
+                assert point in inject.exercised()
+            finally:
+                inject.disarm()
