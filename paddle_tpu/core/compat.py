@@ -101,6 +101,7 @@ def enable_persistent_compilation_cache():
     )
     try:
         os.makedirs(d, exist_ok=True)
+        _atomic_cache_writes()
         # jax's default threshold (1s) is tuned for serving-sized programs;
         # a train step's flush executable compiles faster than that on CPU
         # yet is exactly what a warm restart wants back. Set the threshold
@@ -114,3 +115,58 @@ def enable_persistent_compilation_cache():
         return d
     except Exception:
         return None
+
+
+_atomic_writes_patched = False
+
+
+def _atomic_cache_writes():
+    """Make the persistent-cache entry write ATOMIC on jax versions whose
+    ``LRUCache.put`` uses a bare ``write_bytes`` (jax<=0.4.x): a process
+    killed mid-write (the common fate of driver-timed-out benches, SIGKILL)
+    leaves a truncated serialized executable, and every later process that
+    deserializes it crashes — observed as a deterministic segfault in a
+    single test until the cache dir is cleared. tmp-file + ``os.replace``
+    makes a torn entry impossible; readers either see nothing or a full
+    write. No-op when the jax version has no patchable LRUCache."""
+    global _atomic_writes_patched
+    if _atomic_writes_patched:
+        return
+    try:
+        from jax._src import lru_cache as _lru
+
+        orig_put = _lru.LRUCache.put
+        suffix = getattr(_lru, "_CACHE_SUFFIX", ".bin")
+
+        def atomic_put(self, key, val):
+            # Pre-write the payload file atomically; the original put then
+            # sees it existing and skips its own (torn-write-prone)
+            # write_bytes while still doing the lock/atime bookkeeping.
+            # Thread/process-safe: no global state, and a concurrent
+            # os.replace of the same entry just wins with identical bytes.
+            # (When LRU eviction is explicitly enabled, a pre-written entry
+            # escapes the eviction size accounting — acceptable: this repo
+            # runs the cache unbounded, and a slightly-over-budget cache
+            # beats a segfaulting one.)
+            if key:
+                try:
+                    import time as _time
+
+                    path = self.path / f"{key}{suffix}"
+                    if not path.exists():
+                        # atime sidecar FIRST: orig_put early-returns on an
+                        # existing payload without writing it, and eviction
+                        # read_bytes()-es every entry's atime
+                        atime = self.path / f"{key}{getattr(_lru, '_ATIME_SUFFIX', '.atime')}"
+                        atime.write_bytes(_time.time_ns().to_bytes(8, "little"))
+                        tmp = path.with_name(f"{path.name}.tmp{os.getpid()}")
+                        tmp.write_bytes(val)
+                        os.replace(tmp, path)
+                except OSError:
+                    pass  # fall through: orig_put raises or handles it
+            return orig_put(self, key, val)
+
+        _lru.LRUCache.put = atomic_put
+        _atomic_writes_patched = True
+    except Exception:
+        pass

@@ -562,6 +562,9 @@ def engine_state_dict(engine) -> Dict[str, Any]:
     ``engine_load_state_dict`` (the accum entries here are wrappers around
     copies — writing into them alone would not reach the optimizer)."""
     state = {}
+    sync = getattr(engine, "sync_optimizer_state", None)
+    if sync is not None:
+        sync()  # ZeRO-1 engines keep opt state bucket-flat/sharded; unpack
     for i, p in enumerate(engine.params):
         state[f"param_{i}"] = p
     opt_state = engine.optimizer._functional_state(engine.params)
@@ -593,6 +596,9 @@ def engine_load_state_dict(engine, path) -> None:
             t = state.get(f"accum_{i}_{k}")
             if t is not None:
                 accum[k] = t._data
+    inval = getattr(engine, "invalidate_dp_state", None)
+    if inval is not None:
+        inval()  # next step repacks the sharded state from restored accums
 
 
 __all__ = [

@@ -293,6 +293,63 @@ def split(x, num_partitions, axis=0, group=None):
     return _split(x, num_partitions, axis)
 
 
+# -- quantized collectives (EQuARX, arXiv:2506.17615) ------------------------
+# Blockwise-scaled int8 compression around the DP gradient collectives: each
+# `block`-element tile carries one f32 scale (amax/127), so the wire payload
+# drops ~4x vs f32 (1 byte/elem + 4/block scale bytes). These are ARRAY-level
+# primitives meant to run inside a shard_map trace over a mesh axis; the
+# bucket layer (fleet/grad_buckets.py) guarantees flat inputs whose length
+# divides evenly into nranks shards of whole blocks.
+
+def blockwise_quantize(flat, block=128):
+    """flat (m,) float -> (q int8 (m/block, block), scale f32 (m/block, 1)).
+    m must be a multiple of block."""
+    xb = flat.astype(jnp.float32).reshape(-1, block)
+    scale = jnp.maximum(jnp.max(jnp.abs(xb), axis=1, keepdims=True), 1e-30) / 127.0
+    q = jnp.clip(jnp.round(xb / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def blockwise_dequantize(q, scale, dtype=jnp.float32):
+    return (q.astype(jnp.float32) * scale).reshape(-1).astype(dtype)
+
+
+def quantized_psum_scatter_mean(flat, axis_name, nranks, block=128):
+    """Quantized reduce-scatter-mean of ``flat`` (padded,) over ``axis_name``.
+
+    Each rank splits its local bucket into ``nranks`` shards, compresses
+    every shard blockwise to int8, and all-to-alls so rank i collects all
+    ranks' version of shard i; dequantize + sum + /n gives the mean shard in
+    f32. Returns ``(shard (padded/n,) f32, err (padded,) f32)`` where ``err``
+    is the LOCAL compression residual (x - dequant(quant(x))) — the
+    error-feedback accumulator adds it to the next step's gradient so the
+    suppressed mass is eventually transmitted.
+    """
+    s = flat.shape[0] // nranks
+    # shards are whole blocks (buckets are padded to nranks*block), so the
+    # flat blockwise quantization reshapes losslessly into per-shard tiles
+    q, scale = blockwise_quantize(flat, block)
+    err = flat.astype(jnp.float32) - blockwise_dequantize(q, scale)
+    qt = lax.all_to_all(q.reshape(nranks, s // block, block), axis_name, 0, 0)
+    st = lax.all_to_all(scale.reshape(nranks, s // block, 1), axis_name, 0, 0)
+    shard = jnp.sum(qt.astype(jnp.float32) * st, axis=0).reshape(-1) / nranks
+    return shard, err
+
+
+def quantized_all_reduce_mean(flat, axis_name, nranks, block=128):
+    """Quantized all-reduce-mean: quantized reduce-scatter, then the reduced
+    shard is re-quantized and all-gathered (both wire phases int8+scales).
+    Returns ``(mean (padded,) f32, err (padded,) f32)``; ``err`` covers the
+    reduce-scatter phase (the dominant term — the gather phase's error is
+    identical on every replica so the model stays consistent)."""
+    shard, err = quantized_psum_scatter_mean(flat, axis_name, nranks, block)
+    q2, s2 = blockwise_quantize(shard, block)
+    qg = lax.all_gather(q2.reshape(-1), axis_name, tiled=True)
+    sg = lax.all_gather(s2.reshape(-1), axis_name, tiled=True)
+    out = blockwise_dequantize(qg.reshape(-1, block), sg.reshape(-1, 1))
+    return out, err
+
+
 # -- mp helper prims (reference collective.py:790,876,924,1032) --------------
 def _c_identity(tensor, group=None):
     """Forward identity; backward all-reduce (column-parallel input)."""

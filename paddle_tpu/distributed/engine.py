@@ -78,6 +78,11 @@ class HybridParallelEngine:
         self.buffers = list(model.buffers())
         self._jit = None
         self._placed = False
+        # ZeRO-1 sharded weight update (FLAGS_shard_weight_update): built at
+        # first step for pure-DP meshes; _dp_state holds the engine-resident
+        # bucket-flat optimizer state, physically sharded over the dp axis.
+        self._wus = None
+        self._dp_state = None
 
     # -- placement ---------------------------------------------------------
     def place(self):
@@ -184,8 +189,86 @@ class HybridParallelEngine:
             return loss, new_params, new_state
 
         donate = (0, 1) if self.donate else ()
+        from .fleet.meta_optimizers.hybrid_parallel_optimizer import (
+            ShardedWeightUpdate,
+        )
+
+        self._wus = ShardedWeightUpdate.maybe_build(
+            opt, params, self.mesh, self.dp_axes, self.grad_accumulate
+        )
+        if self._wus is not None:
+            self._jit = jax.jit(
+                self._build_dp_sharded(make_loss_of), donate_argnums=donate
+            )
+            from .. import profiler
+
+            profiler.counter_inc("wus_enabled", 0)  # ensure key exists
+            return
         fn = accum_step_fn if self.grad_accumulate > 1 else step_fn
         self._jit = jax.jit(fn, donate_argnums=donate)
+
+    def _build_dp_sharded(self, make_loss_of):
+        """Communication-optimized pure-DP step: ONE shard_map over the dp
+        axis — local forward/backward on the batch shard, bucketed gradient
+        reduce-scatter (reverse-backward order so XLA overlaps sync with
+        remaining backward compute), 1/dp-shard optimizer update, updated
+        params all-gathered (ZeRO-1; arXiv:2004.13336)."""
+        wus = self._wus
+        axis = wus.axis
+        from jax.sharding import PartitionSpec as P
+
+        from .mesh import shard_map_compat
+
+        _shard_map, _check = shard_map_compat()
+
+        def spmd(p_arrays, dp_state, batch_local, lr, key):
+            # independent per-replica randomness (dropout masks differ per
+            # batch shard, like per-worker seeds in the reference DDP)
+            k = jax.random.fold_in(key, lax.axis_index(axis))
+            loss_of = make_loss_of(batch_local, k)
+            loss, grads = jax.value_and_grad(loss_of)(list(p_arrays))
+            new_params, new_state = wus.apply(p_arrays, grads, dp_state, lr)
+            return lax.pmean(loss, axis), tuple(new_params), new_state
+
+        valid = set(self.mesh.axis_names)
+
+        def clean_spec(spec):
+            out = []
+            for s in tuple(spec):
+                if isinstance(s, (tuple, list)):  # multi-axis entry
+                    kept = tuple(a for a in s if a in valid)
+                    out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+                else:
+                    out.append(s if (s is None or s in valid) else None)
+            return P(*out)
+
+        def step_fn(param_arrays, dp_state, batch_arrays, lr, key):
+            batch_specs = tuple(
+                clean_spec(self.batch_specs[i])
+                if self.batch_specs is not None and i < len(self.batch_specs)
+                else P(axis)
+                for i in range(len(batch_arrays))
+            )
+            fn = _shard_map(
+                spmd,
+                mesh=self.mesh,
+                in_specs=(
+                    tuple(P() for _ in param_arrays),
+                    wus.state_specs(),
+                    batch_specs,
+                    P(),
+                    P(),
+                ),
+                out_specs=(
+                    P(),
+                    tuple(P() for _ in param_arrays),
+                    wus.state_specs(),
+                ),
+                **_check,
+            )
+            return fn(tuple(param_arrays), dp_state, tuple(batch_arrays), lr, key)
+
+        return step_fn
 
     def _prepare(self, *batch):
         self.place()
@@ -196,6 +279,12 @@ class HybridParallelEngine:
             arr = b._data if isinstance(b, Tensor) else jnp.asarray(b)
             batch_arrays.append(jax.device_put(arr, self._batch_sharding(i, arr)))
         param_arrays = [p._data for p in self.params]
+        if self._wus is not None:
+            if self._dp_state is None:
+                self._dp_state = self._wus.init_state(self.mesh)
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+            key = random_state.next_key()
+            return param_arrays, self._dp_state, tuple(batch_arrays), lr, key
         opt_state = self.optimizer._functional_state(self.params)
         # ZeRO: shard accumulators over the sharding axis
         opt_state["accums"] = [
@@ -223,14 +312,54 @@ class HybridParallelEngine:
     @no_grad()
     def train_step(self, *batch):
         param_arrays, opt_state, batch_arrays, lr, key = self._prepare(*batch)
-        loss, new_params, new_state = self._jit(
-            param_arrays, opt_state, batch_arrays, lr, key
-        )
+        try:
+            loss, new_params, new_state = self._jit(
+                param_arrays, opt_state, batch_arrays, lr, key
+            )
+        except Exception:
+            if self._wus is not None and self._dp_state is not None:
+                # the failed launch may have invalidated the donated sharded
+                # state; drop it so the next step repacks from the
+                # optimizer's accumulators (last synced/initial copy) instead
+                # of passing deleted buffers forever
+                deleted = any(
+                    getattr(v, "is_deleted", lambda: False)()
+                    for st in self._dp_state["accums"] for v in st.values()
+                    if isinstance(v, jax.Array)
+                )
+                if deleted:
+                    self._dp_state = None
+            raise
         for p, a in zip(self.params, new_params):
             p._set_data(a)
+        if self._wus is not None:
+            # bucket-flat sharded state stays engine-resident (per-replica
+            # optimizer memory is 1/dp); sync_optimizer_state() unpacks it
+            # into the optimizer's per-param accumulators on demand
+            self._dp_state = new_state
+            self.optimizer._step_count += 1
+            from .. import profiler
+
+            profiler.counter_inc("wus_enabled", 1 - profiler.counters().get("wus_enabled", 0))
+            for k, v in self._wus.step_counters().items():
+                profiler.counter_inc(k, v)
+            return Tensor(loss)
         self.optimizer._functional_restore(self.params, new_state)
         self.optimizer._step_count += 1
         return Tensor(loss)
+
+    def sync_optimizer_state(self):
+        """Unpack the engine-resident ZeRO-1 sharded optimizer state into the
+        optimizer's per-param accumulators (checkpoint save, inspection).
+        No-op for the replicated path, which restores them every step."""
+        if self._wus is not None and self._dp_state is not None:
+            self._wus.sync_back(self._dp_state)
+
+    def invalidate_dp_state(self):
+        """Drop the engine-resident sharded state so the next step repacks it
+        from the optimizer's accumulators (call after restoring a
+        checkpoint into the optimizer)."""
+        self._dp_state = None
 
     @no_grad()
     def eval_step(self, fn, *batch):

@@ -379,9 +379,13 @@ class PipelineTrainStep:
                     k_b = jax.random.fold_in(jax.random.fold_in(key, jb_c), stage_id)
                     dx, dps = lax.switch(
                         stage_id, b_branches, p_arrays, x_b, ids_b, lbl_b, g_up, k_b)
-                    bsel = jnp.where(b_valid, jnp.float32(1.0), jnp.float32(0.0))
+                    # select, don't multiply: a warm-up/drain sub-tick runs
+                    # the vjp on the zero-filled dummy carrier, and e.g. a
+                    # sqrt/norm/log stage makes that dp NaN/Inf — 0*NaN would
+                    # poison the accumulator (the loss/cotangent paths below
+                    # already use jnp.where for exactly this reason)
                     gaccs = tuple(
-                        ga + bsel * dp.astype(jnp.float32)
+                        ga + jnp.where(b_valid, dp.astype(jnp.float32), 0.0)
                         for ga, dp in zip(gaccs, dps)
                     )
                     g_next = lax.ppermute(

@@ -7,6 +7,15 @@ compiler-inserted when the train step runs under pjit with the batch sharded
 on the dp axis — no bucket/fusion machinery is needed (XLA fuses and
 schedules the all-reduces). Inside a shard_map trace, backward hooks psum
 grads over the dp axis to give the same semantics op-for-op.
+
+``apply_collective_grads`` is the explicit reducer path: per-param grads are
+coalesced into reverse-backward-order flat buckets (fleet/grad_buckets.py)
+and synced with a few large collectives — inside a shard_map trace these are
+real pmean/quantized all-reduces over the dp axis; under the eager lazy
+engine the bucketed sync is RECORDED into the pending graph with the bucket
+layout in the node key, so the fused train-step executable keeps a stable
+signature (warm cache) and the displaced full-grad buffers become lazy-flush
+donation candidates.
 """
 from __future__ import annotations
 
@@ -24,6 +33,9 @@ class DataParallel(Layer):
         self._group = group
         self.add_sublayer("_layers", layers)
         self.find_unused_parameters = find_unused_parameters
+        self._comm_buffer_bytes = int(comm_buffer_size) * 1024 * 1024
+        self._bucket_plan = None
+        self._bucket_params = None
 
     def forward(self, *inputs, **kwargs):
         out = self._layers(*inputs, **kwargs)
@@ -49,8 +61,113 @@ class DataParallel(Layer):
     def scale_loss(self, loss):
         return loss
 
+    def _plan_for(self, params, nranks, block):
+        from .fleet.grad_buckets import build_bucket_plan
+
+        sig = (tuple(id(p) for p in params), int(nranks), int(block))
+        if self._bucket_plan is None or self._bucket_params != sig:
+            from ..framework import flags as _flags
+
+            self._bucket_plan = build_bucket_plan(
+                params,
+                nranks=nranks,  # pad so quantized shards divide evenly
+                bucket_bytes=self._comm_buffer_bytes
+                or _flags.flag("FLAGS_dp_bucket_bytes"),
+                block=block,
+            )
+            self._bucket_params = sig
+        return self._bucket_plan
+
     def apply_collective_grads(self):
-        pass
+        """Bucketed gradient sync (the reference Reducer's fused
+        all-reduce). Buckets go out in reverse-backward order; inside a
+        shard_map trace each bucket is one pmean (or EQuARX int8 all-reduce
+        under ``FLAGS_quantized_allreduce``) over the dp axis. Eagerly on a
+        single controller the collective is the identity, but grads are
+        still rebound through the bucketed nodes so the lazy flush donates
+        the dead pre-sync grad buffers."""
+        from ..framework import flags as _flags
+        from .collective import quantized_all_reduce_mean
+
+        params = [
+            p for p in self._layers.parameters()
+            if not p.stop_gradient and p.grad is not None
+        ]
+        if not params:
+            return
+        axis = self._group.axis_name if self._group is not None else "dp"
+        quant = bool(_flags.flag("FLAGS_quantized_allreduce", False))
+        block = int(_flags.flag("FLAGS_quantized_allreduce_block", 128))
+
+        grads = [p.grad._data if isinstance(p.grad, Tensor) else p.grad
+                 for p in params]
+        traced = any(isinstance(g, jax.core.Tracer) for g in grads)
+        from .collective import _axis_bound
+
+        live_axis = traced and _axis_bound(axis)
+        if live_axis:
+            from ..core.compat import axis_size
+
+            n = int(axis_size(axis))
+        else:
+            n = 1
+        plan = self._plan_for(params, n, block)
+        if quant and _flags.flag("FLAGS_quantized_allreduce_error_feedback", False):
+            import warnings
+
+            warnings.warn(
+                "FLAGS_quantized_allreduce_error_feedback has no effect on "
+                "DataParallel.apply_collective_grads — the residual needs "
+                "cross-step state, which only the distributed engine's "
+                "sharded-weight-update path carries",
+                stacklevel=2,
+            )
+
+        from ..core import lazy as lazy_mod
+
+        def sync_bucket(b, *arrs):
+            flat = plan.flatten(b, arrs)
+            if live_axis:
+                if quant:
+                    out, _ = quantized_all_reduce_mean(flat, axis, n, block)
+                    out = out.astype(flat.dtype)
+                else:
+                    out = lax.pmean(flat, axis)
+            else:
+                out = flat  # single participant: identity, still coalesced
+            return tuple(plan.unflatten(b, out))
+
+        from .. import profiler
+
+        record_lazy = not live_axis and (
+            lazy_mod.lazy_enabled() or any(lazy_mod.is_lazy(g) for g in grads)
+        )
+        for b in plan.buckets:
+            b_params = [params[i] for i in b.indices]
+            b_grads = [grads[i] for i in b.indices]
+            if record_lazy:
+                outs, _ = lazy_mod.record(
+                    "dp_bucket_sync",
+                    lambda *a, _b=b: sync_bucket(_b, *a),
+                    list(b_grads),
+                    key=("dp_bucket_sync", plan.signature, b.key(), quant),
+                )
+                synced = outs
+            else:
+                synced = sync_bucket(b, *b_grads)
+            for p, g in zip(b_params, synced):
+                # rebind through the sync: _set_data marks the old grad
+                # buffer as a lazy-flush donation candidate
+                if isinstance(p.grad, Tensor):
+                    p.grad._set_data(g)
+                else:
+                    p.grad = Tensor(g, stop_gradient=True)
+        # dp_buckets counts bucketed sync operations (coalescing ran even at
+        # world 1); the collective-launch/wire counters only count real ones
+        profiler.counter_inc("dp_buckets", len(plan.buckets))
+        if n > 1:
+            profiler.counter_inc("dp_all_reduces", len(plan.buckets))
+            profiler.counter_inc("dp_sync_bytes", plan.sync_bytes("all_reduce", quant))
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)

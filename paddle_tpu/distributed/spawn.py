@@ -16,19 +16,45 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import socket
+import sys
 
 
 def _free_port() -> int:
+    """Probe for a free port. TOCTOU by construction: the socket closes
+    before the child coordinator binds, so on busy hosts another process can
+    grab the port in between — ``spawn`` retries the whole launch with a
+    fresh port when a worker dies on a bind failure (exit ``_BIND_EXIT``)."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+# Distinctive exit code for "coordinator port was taken" (EADDRINUSE=98):
+# the parent's join maps it to a retry-with-fresh-port instead of a failure.
+_BIND_EXIT = 98
+
+
+def _is_bind_error(e: BaseException) -> bool:
+    s = str(e).lower()
+    return ("address already in use" in s or "eaddrinuse" in s
+            or "failed to bind" in s or "errno 98" in s
+            or "error binding" in s)
 
 
 def _worker(func, args):
     # env is inherited from the parent's per-rank os.environ snapshot (set
     # around p.start()): it must be in place BEFORE this function body runs,
     # because unpickling the target itself imports paddle_tpu (and jax).
-    func(*args)
+    try:
+        func(*args)
+    except Exception as e:
+        if _is_bind_error(e):
+            sys.stderr.write(
+                f"paddle_tpu.distributed.spawn worker: coordinator bind "
+                f"failed ({e}); exiting {_BIND_EXIT} for port retry\n"
+            )
+            sys.exit(_BIND_EXIT)
+        raise
 
 
 class SpawnContext:
@@ -53,10 +79,14 @@ class SpawnContext:
                 for p in self.processes:
                     p.join(5)
                 rank, code = bad[0]
-                raise RuntimeError(
+                err = RuntimeError(
                     f"spawn worker rank {rank} exited with code {code} "
                     f"({len(bad)} of {len(self.processes)} workers failed)"
                 )
+                # a _BIND_EXIT rank means the probed coordinator port was
+                # taken before the child bound it (TOCTOU) — spawn() retries
+                err.bind_failure = any(c == _BIND_EXIT for _, c in bad)
+                raise err
             alive = [p for p in self.processes if p.exitcode is None]
             if not alive:
                 return True
@@ -77,9 +107,26 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
     if nprocs <= 1:
         func(*args)
         return None if join else SpawnContext([])
-    coordinator = f"127.0.0.1:{_free_port()}"
     if backend is None:
         backend = "cpu"
+    bind_retries = max(int(options.pop("bind_retries", 3)), 1)
+    for attempt in range(bind_retries):
+        context = _launch(func, args, nprocs, backend, daemon, options)
+        if not join:
+            # caller owns the join — no bind-retry possible past this point
+            return context
+        try:
+            context.join()
+            return None
+        except RuntimeError as e:
+            if not getattr(e, "bind_failure", False) or attempt == bind_retries - 1:
+                raise
+            # coordinator port raced away (classic TOCTOU on busy hosts):
+            # relaunch the whole world on a fresh probe port
+
+
+def _launch(func, args, nprocs, backend, daemon, options):
+    coordinator = f"127.0.0.1:{_free_port()}"
     ctx = mp.get_context("spawn")
     procs = []
     # Children must see the worker env BEFORE their first import: unpickling
@@ -131,8 +178,4 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
-    context = SpawnContext(procs)
-    if join:
-        context.join()
-        return None
-    return context
+    return SpawnContext(procs)

@@ -34,6 +34,22 @@ _FLAGS: Dict[str, object] = {
     # XLA updates weights in place instead of copying ~3x model size per
     # step. FLAGS_lazy_donate=0 is the kill-switch.
     "FLAGS_lazy_donate": True,
+    # ZeRO-1 sharded weight update for pure-DP meshes (arXiv:2004.13336):
+    # reduce_scatter(grads) -> each replica updates its 1/dp shard of params
+    # + optimizer moments -> all_gather(params), with grads coalesced into
+    # reverse-backward-order buckets (fleet/grad_buckets.py). On by default;
+    # the engine falls back to the replicated GSPMD update for hybrid
+    # meshes, non-elementwise rules (LAMB/LARS) and grad accumulation.
+    "FLAGS_shard_weight_update": True,
+    # EQuARX-style blockwise int8 compression of the DP gradient collectives
+    # (collective.py quantized_* prims). Off by default — lossy; enable with
+    # FLAGS_quantized_allreduce_error_feedback to carry the compression
+    # residual into the next step.
+    "FLAGS_quantized_allreduce": False,
+    "FLAGS_quantized_allreduce_block": 128,
+    "FLAGS_quantized_allreduce_error_feedback": False,
+    # Gradient-bucket byte cap (reference DataParallel comm_buffer_size=25MB).
+    "FLAGS_dp_bucket_bytes": 25 * 1024 * 1024,
     # JAX persistent compilation cache (warm executable starts across
     # processes). Dir defaults to ~/.cache/paddle_tpu/xla when unset.
     "FLAGS_xla_persistent_cache": True,

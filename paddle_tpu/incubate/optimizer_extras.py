@@ -31,7 +31,13 @@ class LookAhead:
         self.alpha = float(alpha)
         self.k = int(k)
         self._parameter_list = list(getattr(inner_optimizer, "_parameter_list", []))
-        self._slow = {}  # param name -> slow weight array
+        # reference lookahead.py seeds the slow copy from the BUILD-time
+        # parameters, so the first k-step sync interpolates the fast weights
+        # back toward the initial point (seeding lazily at the first sync
+        # from the current fast weights would make it a no-op)
+        self._slow = {
+            p.name: jnp.asarray(_concrete(p._data)) for p in self._parameter_list
+        }
         self._step_count = 0
 
     def get_lr(self):
@@ -49,10 +55,7 @@ class LookAhead:
         for p in self._parameter_list:
             fast = p._data
             slow = self._slow.get(p.name)
-            if slow is None:
-                # the slow copy starts from the INITIAL weights: seed it from
-                # the pre-update value is unavailable here, so first sync
-                # adopts the current fast weights (reference seeds at build)
+            if slow is None:  # param added after construction: adopt fast
                 slow = fast
             # explicit dtype: a bare python float promotes to f64 under the
             # framework's x64 mode when it passes through the lazy recorder

@@ -38,6 +38,15 @@ _optimizer_uid = itertools.count()
 
 
 class Optimizer:
+    # Whether _rule is a purely ELEMENTWISE map over (param, grad, state)
+    # (no cross-element reductions like norms). Elementwise rules can run on
+    # an arbitrary flat shard of the parameters, which is what the ZeRO-1
+    # sharded weight update (fleet ShardedWeightUpdate) requires. Opt-IN:
+    # the base defaults to False so a user-defined rule with norms/means
+    # falls back to the replicated update instead of silently training
+    # wrong on a flat shard; the shipped elementwise rules set it True.
+    _elementwise_rule = False
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None, **kwargs):
         self._parameter_list = list(parameters) if parameters is not None else None
         self._learning_rate = learning_rate
@@ -249,11 +258,13 @@ class Optimizer:
 
 
 class SGD(Optimizer):
+    _elementwise_rule = True
     def _rule(self, p, g, st, lr, t, wd_scale=1.0):
         return p - lr.astype(p.dtype) * g, st
 
 
 class Momentum(Optimizer):
+    _elementwise_rule = True
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._momentum = momentum
@@ -272,6 +283,7 @@ class Momentum(Optimizer):
 
 
 class Adam(Optimizer):
+    _elementwise_rule = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1 = float(beta1.item()) if isinstance(beta1, Tensor) else beta1
@@ -312,6 +324,7 @@ class AdamW(Adam):
 
 
 class Adamax(Optimizer):
+    _elementwise_rule = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
@@ -328,6 +341,7 @@ class Adamax(Optimizer):
 
 
 class RMSProp(Optimizer):
+    _elementwise_rule = True
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
@@ -349,6 +363,7 @@ class RMSProp(Optimizer):
 
 
 class Adagrad(Optimizer):
+    _elementwise_rule = True
     def __init__(self, learning_rate, epsilon=1e-06, parameters=None, weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._epsilon = epsilon
@@ -363,6 +378,7 @@ class Adagrad(Optimizer):
 
 
 class Adadelta(Optimizer):
+    _elementwise_rule = True
     def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._epsilon, self._rho = epsilon, rho
@@ -408,6 +424,7 @@ class Lamb(Optimizer):
 
 class LarsMomentum(Optimizer):
     """LARS (reference lars_momentum_op.cc)."""
+
 
     def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None, grad_clip=None, name=None, **kw):
         super().__init__(learning_rate, parameters, None, grad_clip, name)

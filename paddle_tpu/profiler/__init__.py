@@ -67,7 +67,16 @@ def counters() -> Dict[str, int]:
     ``retry_attempts`` (fault/retry.py backoff retries), ``naninf_trips``
     (lazy-mode FLAGS_check_nan_inf post-flush trips) and
     ``naninf_donation_suppressed`` (flushes that skipped buffer donation to
-    keep pre-step state inspectable under the nan guard)."""
+    keep pre-step state inspectable under the nan guard).
+
+    DP gradient-sync set (per train step, analytic wire accounting from the
+    bucket plan): ``dp_sync_bytes`` (per-replica payload bytes entering the
+    DP GRADIENT collectives — reduce-scatter for the ZeRO-1 path, both ring
+    phases for bucketed all-reduce; int8+scale bytes when
+    FLAGS_quantized_allreduce is on), ``dp_gather_bytes`` (ZeRO-1
+    updated-param all-gather, full precision), ``dp_buckets`` /
+    ``dp_reduce_scatters`` / ``dp_all_reduces`` (collective launches), and
+    ``wus_enabled`` (1 when the engine runs the sharded weight update)."""
     return dict(_counters)
 
 

@@ -48,9 +48,20 @@ def pytest_configure(config):
         "markers",
         "faults: fault-injection / fault-tolerance tests (CPU-fast, tier-1)",
     )
+    config.addinivalue_line(
+        "markers",
+        "multichip: N-device tests on the virtual CPU mesh (8-device DP "
+        "perf/parity); auto-skipped when the environment provides fewer "
+        "devices — the same skip discipline as the multiprocess-env tests",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
+    n_devices = jax.device_count()
     for item in items:
         if item.module.__name__ in _SMOKE_MODULES:
             item.add_marker(pytest.mark.smoke)
+        if item.get_closest_marker("multichip") is not None and n_devices < 8:
+            item.add_marker(pytest.mark.skip(
+                reason=f"multichip tests need 8 devices, have {n_devices}"
+            ))

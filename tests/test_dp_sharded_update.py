@@ -1,0 +1,471 @@
+"""Communication-optimized DP gradient sync: ZeRO-1 sharded weight update,
+bucketed collectives, and quantized all-reduce.
+
+Methodology per SURVEY.md §4: parity between the sharded path and the
+replicated reference on the 8-device virtual CPU mesh — the same standard the
+reference's TestDistBase applies to its multiprocess runs. Memory claims are
+asserted with array-size accounting over the actual device shardings, and the
+wire-byte claims with the plan's analytic counters (the quantities the driver
+captures from the multichip harness).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import profiler
+from paddle_tpu.distributed.engine import HybridParallelEngine
+from paddle_tpu.distributed.fleet.grad_buckets import build_bucket_plan
+
+pytestmark = pytest.mark.multichip
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("dp",))
+
+
+def _flags(**kw):
+    base = {
+        "FLAGS_shard_weight_update": True,
+        "FLAGS_quantized_allreduce": False,
+        "FLAGS_quantized_allreduce_error_feedback": False,
+    }
+    base.update(kw)
+    paddle.set_flags(base)
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    yield
+    _flags()
+
+
+def _make_model(seed=7, opt_cls=None, **opt_kw):
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 4))
+    opt_cls = opt_cls or paddle.optimizer.Adam
+    o = opt_cls(parameters=m.parameters(), **({"learning_rate": 0.01} | opt_kw))
+    return m, o
+
+
+def _data(n=16):
+    rng = np.random.RandomState(3)
+    return (rng.rand(n, 8).astype(np.float32),
+            rng.rand(n, 4).astype(np.float32))
+
+
+def _loss(m, xb, yb):
+    return ((m(xb) - yb) ** 2).mean()
+
+
+class TestBucketPlan:
+    def test_reverse_order_dtype_homogeneous_and_cap(self):
+        params = [
+            jnp.zeros((64, 64), jnp.float32),    # 16 KB
+            jnp.zeros((64,), jnp.float32),
+            jnp.zeros((32, 32), jnp.float16),    # dtype break
+            jnp.zeros((128, 128), jnp.float32),  # 64 KB (over the cap alone)
+        ]
+        plan = build_bucket_plan(params, nranks=4, bucket_bytes=32 * 1024,
+                                 block=128)
+        # reverse-backward order: last param first
+        assert plan.buckets[0].indices[0] == 3
+        for b in plan.buckets:
+            # dtype-homogeneous
+            assert all(np.dtype(params[i].dtype) == b.dtype for i in b.indices)
+            # padded to nranks*block so shards and blocks divide evenly
+            assert b.padded % (4 * 128) == 0
+            assert b.padded >= b.size
+            # cap respected (single oversized params still get own bucket)
+            if len(b.indices) > 1:
+                assert b.size * b.itemsize <= 32 * 1024 + b.itemsize
+        # the 64 KB param exceeds the cap alone -> its own bucket, then the
+        # f64 param breaks dtype, so >= 3 buckets
+        assert len(plan.buckets) >= 3
+        # flatten/unflatten roundtrip
+        b = plan.buckets[0]
+        arrs = [jnp.arange(int(np.prod(params[i].shape)))
+                .astype(b.dtype).reshape(params[i].shape) for i in b.indices]
+        back = plan.unflatten(b, plan.flatten(b, arrs))
+        for a, r in zip(arrs, back):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(r))
+
+    def test_signature_hashable_and_stable(self):
+        m, o = _make_model()
+        p1 = build_bucket_plan(o._parameter_list, nranks=8)
+        p2 = build_bucket_plan(o._parameter_list, nranks=8)
+        assert hash(p1.signature) == hash(p2.signature)
+        assert p1.signature == p2.signature
+
+    def test_mixed_wd_stays_one_bucket_with_vector_gate(self):
+        m, o = _make_model()
+        wd_of = lambda p: 0.0 if len(p._data.shape) == 1 else 1.0  # gate biases off
+        plan = build_bucket_plan(o._parameter_list, nranks=2, wd_of=wd_of)
+        assert len(plan.buckets) == 1  # wd mix must NOT fragment buckets
+        b = plan.buckets[0]
+        assert b.wd_scale is None
+        vec = np.asarray(plan.wd_vector(b))
+        assert vec.shape == (b.padded,)
+        assert set(np.unique(vec[:b.size])) == {0.0, 1.0}
+
+
+class TestQuantizedPrims:
+    def test_blockwise_roundtrip_error_bound(self):
+        from paddle_tpu.distributed.collective import (
+            blockwise_dequantize, blockwise_quantize,
+        )
+
+        rng = np.random.RandomState(0)
+        x = (rng.randn(4096).astype(np.float32) * 10).astype(np.float32)
+        q, s = blockwise_quantize(jnp.asarray(x), 128)
+        back = np.asarray(blockwise_dequantize(q, s))
+        # per-element error <= half a quantization step of its block
+        step = np.repeat(np.asarray(s).reshape(-1), 128)
+        assert np.all(np.abs(back - x) <= step / 2 + 1e-7)
+
+    def test_quantized_psum_scatter_matches_mean(self):
+        from paddle_tpu.core.compat import shard_map
+        from paddle_tpu.distributed.collective import quantized_psum_scatter_mean
+
+        mesh = _mesh(4)
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 1024).astype(np.float32)
+
+        def f(a):
+            shard, err = quantized_psum_scatter_mean(a.reshape(-1), "dp", 4, 128)
+            return shard, err
+
+        sm = shard_map(f, mesh=mesh, in_specs=P("dp"),
+                       out_specs=(P("dp"), P("dp")), check_vma=False)
+        shard, err = jax.jit(sm)(x.reshape(-1))
+        got = np.asarray(shard)
+        want = x.mean(axis=0)
+        # int8 blockwise: relative error bounded by the block scales
+        scale = np.abs(x).reshape(4, 8, 128).max(-1).max(0) / 127.0
+        bound = np.repeat(scale, 128) * 1.0 + 1e-6
+        assert np.all(np.abs(got - want) <= bound)
+        # error feedback residual matches x - dequant(quant(x)) locally
+        assert np.asarray(err).shape == (4 * 1024,)
+
+
+class TestShardedUpdateParity:
+    @pytest.mark.parametrize("world", [2, 4])
+    def test_params_moments_step_match_unsharded(self, world):
+        """DP=2/4 sharded-weight-update step pinned against the replicated
+        GSPMD path: params, both Adam moments, and step count."""
+        x, y = _data()
+        _flags(FLAGS_shard_weight_update=False)
+        m1, o1 = _make_model()
+        e1 = HybridParallelEngine(m1, o1, _loss, mesh=_mesh(world))
+        l1 = [float(e1.train_step(paddle.to_tensor(x), paddle.to_tensor(y)).item())
+              for _ in range(5)]
+        assert e1._wus is None
+
+        _flags(FLAGS_shard_weight_update=True)
+        m2, o2 = _make_model()
+        e2 = HybridParallelEngine(m2, o2, _loss, mesh=_mesh(world))
+        l2 = [float(e2.train_step(paddle.to_tensor(x), paddle.to_tensor(y)).item())
+              for _ in range(5)]
+        assert e2._wus is not None, "sharded weight update not engaged"
+
+        np.testing.assert_allclose(l1, l2, rtol=1e-5, atol=1e-7)
+        for p1, p2 in zip(e1.params, e2.params):
+            np.testing.assert_allclose(
+                np.asarray(p1._data), np.asarray(p2._data),
+                rtol=1e-5, atol=1e-7, err_msg=p1.name,
+            )
+        assert o1._step_count == o2._step_count == 5
+        e2.sync_optimizer_state()
+        for p1, p2 in zip(e1.params, e2.params):
+            st1 = o1._accumulators[id(p1)]
+            st2 = o2._accumulators[id(p2)]
+            assert sorted(st1) == sorted(st2) == ["moment1", "moment2"]
+            for k in st1:
+                np.testing.assert_allclose(
+                    np.asarray(st1[k]), np.asarray(st2[k]),
+                    rtol=1e-5, atol=1e-7, err_msg=f"{p1.name}.{k}",
+                )
+
+    def test_sgd_momentum_and_adamw_decay_gate(self):
+        """Elementwise rules with state + per-param decay gates survive the
+        flat-shard formulation (wd vector path)."""
+        x, y = _data()
+
+        def make(shard):
+            _flags(FLAGS_shard_weight_update=shard)
+            paddle.seed(9)
+            m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+            o = paddle.optimizer.AdamW(
+                learning_rate=0.01, weight_decay=0.1,
+                parameters=m.parameters(),
+                apply_decay_param_fun=lambda n: "bias" not in n,
+            )
+            e = HybridParallelEngine(m, o, _loss, mesh=_mesh(4))
+            for _ in range(4):
+                e.train_step(paddle.to_tensor(x), paddle.to_tensor(y))
+            return m, e
+
+        m1, e1 = make(False)
+        m2, e2 = make(True)
+        assert e1._wus is None and e2._wus is not None
+        for p1, p2 in zip(e1.params, e2.params):
+            np.testing.assert_allclose(
+                np.asarray(p1._data), np.asarray(p2._data),
+                rtol=1e-5, atol=1e-7, err_msg=p1.name,
+            )
+
+
+class TestOptimizerStateMemory:
+    def test_gpt_opt_state_drops_to_one_over_dp(self):
+        """Acceptance: with FLAGS_shard_weight_update at dp=8, per-replica
+        optimizer-state memory for the GPT bench model is ~1/8 of the
+        replicated path (array-size accounting over device shardings)."""
+        from paddle_tpu.models.gpt import GPTForPretraining, gpt_tiny
+
+        _flags()
+        paddle.seed(0)
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        model = GPTForPretraining(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        eng = HybridParallelEngine(model, opt,
+                                   lambda m, i, l: m.loss(i, l), mesh=_mesh(8))
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (16, 32)))
+        lbl = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (16, 32)))
+        eng.train_step(ids, lbl)
+        assert eng._wus is not None
+
+        replicated_bytes = sum(
+            2 * p.size * np.dtype(p._data.dtype).itemsize  # Adam m+v
+            for p in eng.params
+        )
+        per_device = 0
+        global_total = 0
+        for st in eng._dp_state["accums"]:
+            for v in st.values():
+                global_total += v.size * v.dtype.itemsize
+                per_device += int(
+                    np.prod(v.sharding.shard_shape(v.shape)) * v.dtype.itemsize
+                )
+        # the flats really are 1/8-sharded on each device ...
+        assert per_device * 8 == global_total
+        # ... and per-replica state is ~1/8 of the replicated path (padding
+        # to dp*block elements per bucket is the only slack)
+        ratio = per_device / replicated_bytes
+        assert ratio <= 1 / 8 * 1.10, ratio
+        assert ratio >= 1 / 8 * 0.95, ratio
+
+
+class TestCheckpointRoundtrip:
+    def test_sharded_state_save_resume_matches_uninterrupted(self, tmp_path):
+        """Checkpoint save/resume of the SHARDED optimizer state: 3 steps,
+        save, restore into a fresh engine, 2 more steps == 5 uninterrupted
+        steps (params and moments)."""
+        from paddle_tpu.distributed.checkpoint import (
+            engine_load_state_dict, engine_state_dict, save_state_dict,
+        )
+
+        x, y = _data()
+        _flags()
+
+        def steps(e, n):
+            for _ in range(n):
+                loss = e.train_step(paddle.to_tensor(x), paddle.to_tensor(y))
+            return float(loss.item())
+
+        m_ref, o_ref = _make_model()
+        e_ref = HybridParallelEngine(m_ref, o_ref, _loss, mesh=_mesh(4))
+        steps(e_ref, 5)
+
+        m1, o1 = _make_model()
+        e1 = HybridParallelEngine(m1, o1, _loss, mesh=_mesh(4))
+        assert steps(e1, 3) is not None
+        assert e1._wus is not None
+        save_state_dict(engine_state_dict(e1), str(tmp_path / "ck"))
+
+        m2, o2 = _make_model(seed=123)  # different init: restore must win
+        e2 = HybridParallelEngine(m2, o2, _loss, mesh=_mesh(4))
+        steps(e2, 1)  # materialize engine state before restoring over it
+        engine_load_state_dict(e2, str(tmp_path / "ck"))
+        assert o2._step_count == 3
+        steps(e2, 2)
+
+        for pr, p2 in zip(e_ref.params, e2.params):
+            np.testing.assert_allclose(
+                np.asarray(pr._data), np.asarray(p2._data),
+                rtol=1e-5, atol=1e-7, err_msg=pr.name,
+            )
+        e_ref.sync_optimizer_state()
+        e2.sync_optimizer_state()
+        for pr, p2 in zip(e_ref.params, e2.params):
+            for k in o_ref._accumulators[id(pr)]:
+                np.testing.assert_allclose(
+                    np.asarray(o_ref._accumulators[id(pr)][k]),
+                    np.asarray(o2._accumulators[id(p2)][k]),
+                    rtol=1e-5, atol=1e-7, err_msg=f"{pr.name}.{k}",
+                )
+
+
+class TestQuantizedAllReduce:
+    def _run(self, quantized, error_feedback=False, steps=8):
+        _flags(FLAGS_quantized_allreduce=quantized,
+               FLAGS_quantized_allreduce_error_feedback=error_feedback)
+        profiler.reset_counters()
+        x, y = _data()
+        m, o = _make_model()
+        e = HybridParallelEngine(m, o, _loss, mesh=_mesh(4))
+        losses = [float(e.train_step(paddle.to_tensor(x),
+                                     paddle.to_tensor(y)).item())
+                  for _ in range(steps)]
+        return losses, dict(profiler.counters()), e
+
+    def test_bytes_shrink_3x_and_loss_divergence_bounded(self):
+        """Acceptance: dp_sync_bytes shrink >= 3x with int8 on the same
+        model; the quantized loss curve stays within 2% of fp32 sync."""
+        fp, c_fp, _ = self._run(False)
+        q, c_q, _ = self._run(True)
+        shrink = c_fp["dp_sync_bytes"] / c_q["dp_sync_bytes"]
+        assert shrink >= 3.0, shrink
+        # parity pin: blockwise int8 on smooth losses diverges slowly
+        for lf, lq in zip(fp, q):
+            assert abs(lq - lf) / max(abs(lf), 1e-6) < 0.02, (lf, lq)
+
+    def test_error_feedback_carries_residual(self):
+        q, _, e = self._run(True, error_feedback=True)
+        assert all(np.isfinite(l) for l in q)
+        assert e._dp_state["ef"], "error-feedback state missing"
+        ef = np.asarray(e._dp_state["ef"][0])
+        assert np.abs(ef).max() > 0.0  # residual actually accumulated
+        fp, _, _ = self._run(False)
+        for lf, lq in zip(fp, q):
+            assert abs(lq - lf) / max(abs(lf), 1e-6) < 0.02, (lf, lq)
+
+
+class TestCountersAndFallbacks:
+    def test_counters_emitted_per_step(self):
+        _flags()
+        profiler.reset_counters()
+        x, y = _data()
+        m, o = _make_model()
+        e = HybridParallelEngine(m, o, _loss, mesh=_mesh(8))
+        for _ in range(3):
+            e.train_step(paddle.to_tensor(x), paddle.to_tensor(y))
+        c = profiler.counters()
+        assert c["wus_enabled"] == 1
+        assert c["dp_buckets"] == 3 * len(e._wus.plan)
+        assert c["dp_reduce_scatters"] == c["dp_buckets"]
+        assert c["dp_sync_bytes"] == 3 * e._wus.plan.sync_bytes("reduce_scatter")
+        assert c["dp_gather_bytes"] == 3 * e._wus.plan.gather_bytes()
+
+    def test_lamb_falls_back_to_replicated(self):
+        """Non-elementwise rules (trust-ratio norms) must not take the
+        flat-shard path."""
+        _flags()
+        x, y = _data()
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        o = paddle.optimizer.Lamb(learning_rate=0.01, parameters=m.parameters())
+        e = HybridParallelEngine(m, o, _loss, mesh=_mesh(4))
+        loss = e.train_step(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert e._wus is None
+        assert np.isfinite(float(loss.item()))
+
+    def test_hybrid_mesh_falls_back(self):
+        _flags()
+        x, y = _data()
+        m, o = _make_model()
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(4, 2), ("dp", "mp"))
+        e = HybridParallelEngine(m, o, _loss, mesh=mesh)
+        loss = e.train_step(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert e._wus is None  # GSPMD owns hybrid meshes
+        assert np.isfinite(float(loss.item()))
+
+    def test_grad_accumulate_falls_back(self):
+        _flags()
+        x, y = _data()
+        m, o = _make_model()
+        e = HybridParallelEngine(m, o, _loss, mesh=_mesh(4), grad_accumulate=4)
+        loss = e.train_step(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert e._wus is None
+        assert np.isfinite(float(loss.item()))
+
+    def test_kill_switch(self):
+        _flags(FLAGS_shard_weight_update=False)
+        x, y = _data()
+        m, o = _make_model()
+        e = HybridParallelEngine(m, o, _loss, mesh=_mesh(8))
+        e.train_step(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert e._wus is None
+
+
+class TestDataParallelBucketedSync:
+    def test_traced_bucket_sync_pmean_parity(self):
+        """apply_collective_grads inside a dp shard_map: every param grad
+        comes back as the cross-replica mean, via a handful of flat-bucket
+        collectives."""
+        from paddle_tpu.core.compat import shard_map
+        from paddle_tpu.distributed.collective import Group
+        from paddle_tpu.distributed.parallel import DataParallel
+
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        dp = DataParallel(m, group=Group(axis_name="dp"))
+        mesh = _mesh(4)
+
+        def f(g1, g2):
+            saved = (m.weight.grad, m.bias.grad)
+            try:
+                m.weight.grad = paddle.Tensor(g1, stop_gradient=True)
+                m.bias.grad = paddle.Tensor(g2, stop_gradient=True)
+                dp.apply_collective_grads()
+                return m.weight.grad._data, m.bias.grad._data
+            finally:
+                m.weight.grad, m.bias.grad = saved
+
+        gw = np.random.RandomState(0).randn(4, 4, 2).astype(np.float32)
+        gb = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        sm = shard_map(f, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                       out_specs=(P("dp"), P("dp")), check_vma=False)
+        ow, ob = jax.jit(sm)(gw.reshape(16, 2), gb.reshape(8))
+        ow = np.asarray(ow).reshape(4, 4, 2)
+        ob = np.asarray(ob).reshape(4, 2)
+        for r in range(4):
+            np.testing.assert_allclose(ow[r], gw.mean(0), rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(ob[r], gb.mean(0), rtol=1e-5, atol=1e-6)
+
+    def test_lazy_bucketed_sync_stable_signature(self):
+        """Eager-lazy mode: the bucketed sync records into the pending graph
+        with the bucket layout in the key — identical iterations keep
+        hitting the warm flush executable, and the displaced grad buffers
+        feed the donation pass."""
+        from paddle_tpu.distributed.parallel import DataParallel
+
+        paddle.seed(1)
+        m = nn.Linear(8, 4)
+        dp = DataParallel(m)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        x = paddle.to_tensor(np.random.RandomState(2).randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(3).randn(8, 4).astype(np.float32))
+
+        def step():
+            loss = ((dp(x) - y) ** 2).mean()
+            loss.backward()
+            dp.apply_collective_grads()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        step()  # compile
+        c0 = profiler.counters()
+        l1 = float(step().item())
+        c1 = profiler.counters()
+        l2 = float(step().item())
+        c2 = profiler.counters()
+        assert np.isfinite(l1) and np.isfinite(l2) and l2 < l1
+        assert c1["dp_buckets"] == c0.get("dp_buckets", 0) + 1
+        # identical iteration -> flush signature unchanged -> cache hit
+        assert c2["lazy_cache_hits"] > c1.get("lazy_cache_hits", 0)

@@ -213,6 +213,41 @@ def _failing_worker():
     sys.exit(3)
 
 
+def _bind_flaky_worker(marker):
+    # first attempt: simulate the coordinator losing the probed port to
+    # another process (the _free_port TOCTOU); later attempts succeed
+    import os
+
+    first = not os.path.exists(marker)
+    with open(marker, "a") as f:
+        f.write("x")
+    if first:
+        raise RuntimeError("Failed to bind: Address already in use (port 9999)")
+
+
+class TestSpawnPortRetry:
+    def test_spawn_retries_on_coordinator_bind_failure(self, tmp_path):
+        """ADVICE r5 (_free_port TOCTOU): a worker dying on a bind error
+        exits with the dedicated retry code and spawn relaunches the whole
+        world on a fresh probe port instead of failing the job."""
+        from paddle_tpu.distributed.spawn import spawn
+
+        marker = str(tmp_path / "attempt")
+        spawn(_bind_flaky_worker, args=(marker,), nprocs=2)
+        # attempt 1 wrote >=1 'x' then died on the bind error; attempt 2's
+        # two ranks both ran clean
+        assert len((tmp_path / "attempt").read_text()) >= 3
+
+    def test_non_bind_failure_does_not_retry(self, tmp_path):
+        from paddle_tpu.distributed.spawn import spawn
+
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="code 3"):
+            spawn(_failing_worker, nprocs=2)
+        # a single launch, not bind_retries relaunches
+        assert time.time() - t0 < 120
+
+
 class TestLauncher:
     def test_cluster_topology(self):
         from paddle_tpu.distributed.launch_mod import get_cluster

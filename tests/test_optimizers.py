@@ -292,6 +292,41 @@ class TestIncubateOptimizerExtras:
         with pytest.raises(ValueError, match="slow-weight keys"):
             la3.set_state_dict(sd)
 
+    def test_lookahead_first_sync_interpolates_from_init(self):
+        """ADVICE r5: slow weights seed from the BUILD-time params, so the
+        FIRST k-step sync lands at w0 + alpha*(w_k - w0) — lazily adopting
+        the current fast weights would make it a no-op (== w_k)."""
+        from paddle_tpu.incubate import LookAhead
+
+        X, Y = self._fit_problem()
+
+        def run_steps(opt_factory, steps):
+            paddle.seed(6)
+            m = nn.Linear(6, 1)
+            w0 = m.weight.numpy().copy()
+            opt = opt_factory(m)
+            for _ in range(steps):
+                loss = ((m(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            return w0, m.weight.numpy()
+
+        k, alpha = 3, 0.5
+        # fast-only reference: plain SGD k steps -> w_k
+        _, w_k = run_steps(
+            lambda m: paddle.optimizer.SGD(learning_rate=0.05,
+                                           parameters=m.parameters()), k)
+        w0, w_sync = run_steps(
+            lambda m: LookAhead(
+                paddle.optimizer.SGD(learning_rate=0.05,
+                                     parameters=m.parameters()),
+                alpha=alpha, k=k), k)
+        want = w0 + alpha * (w_k - w0)
+        np.testing.assert_allclose(w_sync, want, rtol=1e-5, atol=1e-7)
+        # and it is NOT the no-op (w_k itself)
+        assert np.abs(w_sync - w_k).max() > 1e-6
+
     def test_model_average_apply_restore(self):
         from paddle_tpu.incubate import ModelAverage
 

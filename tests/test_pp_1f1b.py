@@ -156,3 +156,101 @@ class Test1F1B:
         print(f"\npp=4 n_micro={n_micro} steps/s: {results}")
         # sanity only: both run; 1F1B must be within 3x of F-then-B
         assert results["1F1B"] > results["F-then-B"] / 3.0
+
+
+def build_sqrt_pl(n_stages=2):
+    """Pipeline whose middle block has an UNDEFINED derivative at 0:
+    ``sqrt(|x|)`` — d/dx = sign(x)/(2 sqrt(|x|)) is 0 * inf = NaN at x=0.
+    Warm-up/drain backward sub-ticks run the vjp on the zero-filled dummy
+    carrier, so this stage produces NaN param cotangents on every invalid
+    tick (ADVICE r5: arithmetic 0/1 masking turns them into 0*NaN = NaN)."""
+    from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+    class Embed(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(VOCAB, D)
+
+        def forward(self, ids):
+            return self.emb(ids)
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(D, D)
+
+        def forward(self, x):
+            return x + paddle.tanh(self.fc(x))
+
+    class SqrtBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            # no bias: fc(0) == 0, so the zero dummy carrier hits sqrt's
+            # singular point and d(sqrt|fc|)/dW = NaN flows into this
+            # stage's param cotangents on invalid sub-ticks
+            self.fc = nn.Linear(D, D, bias_attr=False)
+
+        def forward(self, x):
+            # real activations are a.s. nonzero -> finite grads
+            return paddle.sqrt(paddle.abs(self.fc(x))) * 0.1 + x
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Linear(D, VOCAB)
+
+        def forward(self, x):
+            return self.proj(x)
+
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(logits, labels):
+        return ce(logits.reshape([-1, VOCAB]), labels.reshape([-1]))
+
+    # 4 descs over 2 stages -> stage1 = [SqrtBlock, Head]: the sqrt stage
+    # receives the inter-stage carrier (zeros on warm-up/drain sub-ticks)
+    descs = [LayerDesc(Embed), LayerDesc(Block), LayerDesc(SqrtBlock),
+             LayerDesc(Head)]
+    return PipelineLayer(descs, num_stages=n_stages, loss_fn=loss_fn)
+
+
+class Test1F1BNaNMasking:
+    def test_nan_at_zero_stage_does_not_poison_grads(self):
+        """Regression (ADVICE r5, pipeline_parallel.py:372): invalid
+        backward sub-ticks must be masked per leaf with jnp.where, not by
+        multiplying with a 0/1 scalar — sqrt'(0)=inf on the dummy carrier
+        would otherwise poison the whole step's gradient accumulator."""
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+            PipelineTrainStep,
+        )
+        from paddle_tpu.jit import CompiledTrainStep
+
+        n_micro = 4
+        ids, labels = _data(n_micro, seed=13)
+
+        # sequential reference (no dummy carrier ever exists, so no
+        # singular vjp): same weights via same seed
+        paddle.seed(5)
+        m1 = build_sqrt_pl()
+        o1 = paddle.optimizer.SGD(learning_rate=0.05, parameters=m1.parameters())
+        lf = m1._loss_fn
+        seq = CompiledTrainStep(m1, lambda m, x, y: lf(m(x), y), o1)
+        seq_losses = [float(seq(ids, labels).item()) for _ in range(2)]
+        assert all(np.isfinite(l) for l in seq_losses), seq_losses
+
+        paddle.seed(5)
+        pl = build_sqrt_pl()
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=pl.parameters())
+        step = PipelineTrainStep(pl, opt, _mesh(2), n_micro=n_micro,
+                                 schedule="1F1B")
+        ls = [float(step(ids, labels).item()) for _ in range(2)]
+        assert all(np.isfinite(l) for l in ls), ls
+        for p in pl.parameters():
+            assert np.isfinite(np.asarray(p._data)).all(), p.name
+        # the drain-tick NaNs masked correctly, the pipelined run must match
+        # sequential training step-for-step
+        np.testing.assert_allclose(ls, seq_losses, rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(pl.parameters()[0]._data),
+            np.asarray(m1.parameters()[0]._data), rtol=2e-4, atol=1e-5,
+        )

@@ -178,6 +178,29 @@ class TestMetricOps:
         want = np.mean([(p > n) + 0.5 * (p == n) for p in pos for n in neg])
         assert abs(got - want) < 1e-6
 
+    def test_auc_large_n(self):
+        """ADVICE r5: the rank statistic must be O(N log N) (searchsorted),
+        not two N x N comparison matrices (~10 GB at N~1e5). Random scores
+        at N=2e5 must run fast and land near 0.5; a separable slab must
+        score ~1.0."""
+        rng = np.random.RandomState(0)
+        n = 200_000
+        pred = rng.rand(n).astype(np.float32)
+        label = (rng.rand(n) < 0.3).astype(np.int64)
+        got = float(mops.auc(T(pred), T(label))._data)
+        assert 0.49 < got < 0.51, got
+        sep = float(mops.auc(T(np.where(label > 0, pred + 2.0, pred).astype(np.float32)),
+                             T(label))._data)
+        assert sep > 0.999, sep
+        # parity with the pairwise definition on a slice (ties included)
+        small = 400
+        p_s = np.round(pred[:small], 2).astype(np.float32)  # force ties
+        y_s = label[:small]
+        got_s = float(mops.auc(T(p_s), T(y_s))._data)
+        pos, neg = p_s[y_s == 1], p_s[y_s == 0]
+        want = np.mean([(p > q) + 0.5 * (p == q) for p in pos for q in neg])
+        assert abs(got_s - want) < 1e-5, (got_s, want)
+
     def test_edit_distance(self):
         hyp = np.array([[1, 2, 3, 0], [4, 4, 0, 0]])
         hl = np.array([3, 2])
