@@ -149,6 +149,23 @@ def _nonfinite_error(name, idx, arr, origin="eager", hint=False):
             "unfused and attribute the first non-finite value to its "
             "producing op."
         )
+    # Every non-finite diagnostic (eager, lazy flush, per-op replay) writes a
+    # flight-recorder post-mortem BEFORE the raise: the dump's active-span
+    # stack names the producing flush span, and recent spans + counters show
+    # what the engine was doing when the value went bad.
+    try:
+        from ..profiler import flight
+
+        flight.dump(
+            "naninf",
+            extra={
+                "op": name, "output": idx, "origin": origin,
+                "nonfinite_count": cnt, "first_flat_index": flat_idx,
+                "message": msg,
+            },
+        )
+    except Exception:
+        pass
     return FloatingPointError(msg)
 
 
@@ -162,6 +179,7 @@ def _check_nan_inf(name, outs, origin="eager"):
     for i, o in enumerate(outs):
         if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating):
             if not bool(jnp.isfinite(o).all()):
+                _prof().counter_inc("naninf_trips")
                 raise _nonfinite_error(name, i, o, origin=origin)
 
 

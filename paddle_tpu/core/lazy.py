@@ -479,6 +479,32 @@ def _interp(fns, wiring, leaf_vals, on_node=None):
     return env
 
 
+# span-tracer module, bound once at first flush (same pattern as
+# dispatch._prof — flush runs once per iteration, not per op, so the span is
+# cheap; the flight recorder keeps it even with the profiler closed)
+_spans_mod = None
+
+
+def _spans():
+    global _spans_mod
+    if _spans_mod is None:
+        from ..profiler import spans
+
+        _spans_mod = spans
+    return _spans_mod
+
+
+def pending_summary() -> dict:
+    """Post-mortem view of this thread's pending graph (flight recorder):
+    node count and the tail of op names awaiting execution."""
+    g = getattr(_state, "graph", None)
+    nodes = g.nodes if g is not None else []
+    return {
+        "pending_nodes": len(nodes),
+        "tail_ops": [n.key[0] for n in nodes[-8:]],
+    }
+
+
 def flush():
     """Execute all pending nodes as one jitted XLA computation and write the
     results back into the live LazyArrays."""
@@ -489,25 +515,13 @@ def flush():
         return
     _state.flushing = True
     try:
-        from .dispatch import _prof
-
-        p = _prof()
-        if p._enabled:
-            import time as _time
-
-            _t0 = _time.perf_counter_ns()
-            n = len(g.nodes)
-            try:
-                _flush_impl(g)
-            finally:
-                p._record(f"lazy::flush[{n} ops]", _t0)
-        else:
-            _flush_impl(g)
+        with _spans().span("lazy_flush", nodes=len(g.nodes)) as sp:
+            _flush_impl(g, sp)
     finally:
         _state.flushing = False
 
 
-def _flush_impl(g: _Graph):
+def _flush_impl(g: _Graph, sp=None):
     nodes = g.nodes
     g.nodes = []
     node_index = {id(n): i for i, n in enumerate(nodes)}
@@ -518,37 +532,40 @@ def _flush_impl(g: _Graph):
     via_lazy: set = set()  # leaf ids reached through a LazyArray._concrete
     descs_all: list = []
     sig_parts: list = []
-    for n in nodes:
-        descs = []
-        for x in n.inputs:
-            indirect = False
-            if isinstance(x, LazyArray):
-                if x._concrete is not None:
-                    x = x._concrete
-                    indirect = True
+    with _spans().span("trace", nodes=len(nodes)) as trace_span:
+        for n in nodes:
+            descs = []
+            for x in n.inputs:
+                indirect = False
+                if isinstance(x, LazyArray):
+                    if x._concrete is not None:
+                        x = x._concrete
+                        indirect = True
+                    else:
+                        i = node_index.get(id(x._node))
+                        if i is None:
+                            raise RuntimeError(
+                                "lazy graph invariant violated: input from a "
+                                "flushed-but-unmaterialized node"
+                            )
+                        descs.append(("n", i, x._idx))
+                        continue
+                j = leaf_pos.get(id(x))
+                if j is None:
+                    j = len(leaves)
+                    leaf_pos[id(x)] = j
+                    leaves.append(x)
+                if indirect:
+                    via_lazy.add(id(x))
                 else:
-                    i = node_index.get(id(x._node))
-                    if i is None:
-                        raise RuntimeError(
-                            "lazy graph invariant violated: input from a "
-                            "flushed-but-unmaterialized node"
-                        )
-                    descs.append(("n", i, x._idx))
-                    continue
-            j = leaf_pos.get(id(x))
-            if j is None:
-                j = len(leaves)
-                leaf_pos[id(x)] = j
-                leaves.append(x)
-            if indirect:
-                via_lazy.add(id(x))
-            else:
-                direct_uses[id(x)] = direct_uses.get(id(x), 0) + 1
-            descs.append(("l", j))
-        descs_all.append(tuple(descs))
-        alive = tuple(r() is not None for r in n.out_refs)
-        sig_parts.append((n.key, tuple(descs), alive))
-    x = n = None  # drop loop bindings: they'd count as refs in the mask pass
+                    direct_uses[id(x)] = direct_uses.get(id(x), 0) + 1
+                descs.append(("l", j))
+            descs_all.append(tuple(descs))
+            alive = tuple(r() is not None for r in n.out_refs)
+            sig_parts.append((n.key, tuple(descs), alive))
+        # drop loop bindings: they'd count as refs in the donation mask pass
+        x = n = None
+        trace_span.set(leaves=len(leaves))
 
     # Liveness pass: donate leaves that were rebound through this graph and
     # that nothing outside the graph still references. The mask is part of
@@ -567,8 +584,12 @@ def _flush_impl(g: _Graph):
             from .dispatch import _prof as _prof_fn
 
             _prof_fn().counter_inc("naninf_donation_suppressed")
+            if sp is not None:
+                sp.set(donation="suppressed_naninf")
         else:
-            donate_ix = _donation_mask(leaves, cand, direct_uses, via_lazy)
+            with _spans().span("donate", candidates=len(cand)) as dsp:
+                donate_ix = _donation_mask(leaves, cand, direct_uses, via_lazy)
+                dsp.set(donated=len(donate_ix))
     if cand:
         cand.clear()
 
@@ -584,6 +605,14 @@ def _flush_impl(g: _Graph):
     prof.counter_inc("lazy_flushes")
 
     entry = _flush_cache.get(sig) if sig is not None else None
+    cache_hit = entry is not None
+    if sp is not None:
+        # the executable-cache key: stable within a process (str hashing is
+        # seeded per-process), enough to correlate hit/miss spans in a trace
+        sp.set(
+            cache="hit" if cache_hit else "miss",
+            cache_key=(f"{hash(sig) & 0xFFFFFFFFFFFFFFFF:016x}" if sig is not None else None),
+        )
     if entry is None:
         fns = [n2.fn for n2 in nodes]
         wiring = descs_all
@@ -613,10 +642,20 @@ def _flush_impl(g: _Graph):
         prof.counter_inc("lazy_cache_hits")
 
     jitted, live, replay, don = entry
+    if sp is not None and don:
+        sp.set(
+            donated_buffers=len(don),
+            donated_bytes=sum(int(getattr(leaves[j], "nbytes", 0)) for j in don),
+        )
     try:
         if don:
             _ignore_donation_warnings()
-        results = jitted(*leaves)
+        # a miss pays trace+compile inside this first invocation; a hit is a
+        # pure executable replay — the span name is the attribution
+        with _spans().span(
+            "execute" if cache_hit else "compile", cache="hit" if cache_hit else "miss"
+        ):
+            results = jitted(*leaves)
         if don:
             prof.counter_inc("lazy_donated_buffers", len(don))
     except Exception:
@@ -630,24 +669,47 @@ def _flush_impl(g: _Graph):
             # before invalidating inputs): permanently fall back to a
             # non-donating executable under this signature
             prof.counter_inc("lazy_donation_fallbacks")
+            if sp is not None:
+                sp.set(fallback="donation_rejected")
             jitted = jax.jit(replay)
             entry[0] = jitted
             entry[3] = ()
             try:
-                results = jitted(*leaves)
+                with _spans().span("compile", cache="miss", fallback="donation_rejected"):
+                    results = jitted(*leaves)
             except Exception:
-                results = replay(*[jnp.asarray(v) for v in leaves])
+                if sp is not None:
+                    sp.set(fallback="eager_replay")
+                with _spans().span("execute", fallback="eager_replay"):
+                    results = replay(*[jnp.asarray(v) for v in leaves])
         elif donated_dead:
             # inputs were invalidated mid-execution; eager replay impossible
             raise
         else:
             # fallback: run un-jitted (still one pass, concrete ops)
-            results = replay(*[jnp.asarray(v) for v in leaves])
+            if sp is not None:
+                sp.set(fallback="eager_replay")
+            with _spans().span("execute", fallback="eager_replay"):
+                results = replay(*[jnp.asarray(v) for v in leaves])
 
     for (i, j), val in zip(live, results):
         o = nodes[i].out_refs[j]()
         if o is not None:
             o._concrete = val
+
+    # Memory accounting (profiler profile_memory / FLAGS_profile_memory):
+    # live-buffer census at the flush boundary — the point where donated
+    # inputs are gone and outputs exist, so the delta IS the step's real
+    # memory effect and the peak gauge tracks the high-water mark.
+    if prof._memory_active():
+        mem = prof.memory_census()
+        if sp is not None:
+            sp.set(
+                live_bytes=mem["live_bytes"],
+                live_arrays=mem["live_arrays"],
+                peak_live_bytes=mem["peak_live_bytes"],
+                delta_bytes=mem["last_delta_bytes"],
+            )
 
     # FLAGS_check_nan_inf under the lazy engine: scan the flush outputs AFTER
     # the writeback (the materialized state stays inspectable — donation was

@@ -20,6 +20,10 @@ _SO = os.path.join(_RUNTIME_DIR, "libpaddle_tpu_runtime.so")
 _lib = None
 _lock = threading.Lock()
 
+# True when the loaded .so carries the profiler span ring
+# (trace.cc ptt_span_record/ptt_span_drain); stale builds predate it.
+HAS_SPANS = False
+
 
 def _build():
     subprocess.run(["make", "-C", _RUNTIME_DIR], check=True, capture_output=True)
@@ -95,6 +99,21 @@ def lib():
         L.ptt_name.restype = ctypes.c_char_p
         L.ptt_name.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         L.ptt_reset.argtypes = [ctypes.c_void_p]
+        # trace span ring (absent from pre-span builds of the .so)
+        global HAS_SPANS
+        try:
+            L.ptt_span_record.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64,
+            ]
+            L.ptt_span_drain.restype = ctypes.c_int64
+            L.ptt_span_drain.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ]
+            HAS_SPANS = True
+        except AttributeError:
+            HAS_SPANS = False
         # arena
         L.pta_create.restype = ctypes.c_void_p
         L.pta_create.argtypes = [ctypes.c_int64]

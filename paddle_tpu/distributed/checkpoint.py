@@ -268,9 +268,12 @@ class _PendingSave:
     def wait_until_finished(self):
         if self._done:
             return
-        self._ck.wait_until_finished()
-        if self._manifest is not None:
-            _write_manifest(self._manifest, self._path)
+        from ..profiler import spans as _spans
+
+        with _spans.span("ckpt_commit", path=self._path, async_save=True):
+            self._ck.wait_until_finished()
+            if self._manifest is not None:
+                _write_manifest(self._manifest, self._path)
         _prof().counter_inc("ckpt_saves")
         self._done = True
 
@@ -289,47 +292,55 @@ def save_state_dict(
     Checksums are computed from the live arrays BEFORE the write starts, and
     the manifest (commit marker) is written only after orbax finalizes — for
     async saves, inside ``wait_until_finished()``."""
-    arrays = _to_arrays(state_dict)
-    path = os.path.abspath(path)
-    man = _build_manifest(arrays, step=step) if manifest else None
-    old = None
-    if os.path.exists(path):
-        # keep the previous checkpoint until the new one lands (atomicity:
-        # orbax writes tmp+rename, so a fresh path is safe; the old copy is
-        # parked aside WITH its manifest and dropped only after a successful
-        # save — resume treats a committed .old as a valid fallback)
-        old = path + ".old"
-        shutil.rmtree(old, ignore_errors=True)
-        _remove_manifest(old)
-        os.rename(path, old)
-        _move_manifest(path, old)
-    ck = _ckpt(async_mode=async_save)
-    try:
-        from ..fault import inject as _inject
+    from ..profiler import spans as _spans
 
-        _inject.check("ckpt.write", path=path)
-        ck.save(path, arrays)
-    except Exception:
-        if old and not os.path.exists(path):
-            os.rename(old, path)
-            _move_manifest(old, path)
-        raise
-    # the .old backup is kept until the new checkpoint is COMMITTED: the
-    # finalize (background atomic rename) may still fail/crash, and the
-    # backup is the only good copy until the manifest lands. Async saves
-    # keep it until the NEXT save parks it away.
-    if async_save:
-        return _PendingSave(ck, man, path, old)
-    # StandardCheckpointer finalizes (atomic rename) in the background even
-    # on the "sync" path — block so the artifact is durable, then commit
-    getattr(ck, "wait_until_finished", lambda: None)()
-    if man is not None:
-        _write_manifest(man, path)
-    _prof().counter_inc("ckpt_saves")
-    if old:
-        shutil.rmtree(old, ignore_errors=True)
-        _remove_manifest(old)
-    return None
+    with _spans.span("ckpt_save", step=step, async_save=async_save) as sp:
+        with _spans.span("serialize"):
+            arrays = _to_arrays(state_dict)
+            path = os.path.abspath(path)
+            man = _build_manifest(arrays, step=step) if manifest else None
+        sp.set(leaves=len(man["tree"]) if man else 0, path=path)
+        old = None
+        if os.path.exists(path):
+            # keep the previous checkpoint until the new one lands
+            # (atomicity: orbax writes tmp+rename, so a fresh path is safe;
+            # the old copy is parked aside WITH its manifest and dropped only
+            # after a successful save — resume treats a committed .old as a
+            # valid fallback)
+            old = path + ".old"
+            shutil.rmtree(old, ignore_errors=True)
+            _remove_manifest(old)
+            os.rename(path, old)
+            _move_manifest(path, old)
+        ck = _ckpt(async_mode=async_save)
+        try:
+            from ..fault import inject as _inject
+
+            _inject.check("ckpt.write", path=path)
+            with _spans.span("write", async_save=async_save):
+                ck.save(path, arrays)
+        except Exception:
+            if old and not os.path.exists(path):
+                os.rename(old, path)
+                _move_manifest(old, path)
+            raise
+        # the .old backup is kept until the new checkpoint is COMMITTED: the
+        # finalize (background atomic rename) may still fail/crash, and the
+        # backup is the only good copy until the manifest lands. Async saves
+        # keep it until the NEXT save parks it away.
+        if async_save:
+            return _PendingSave(ck, man, path, old)
+        # StandardCheckpointer finalizes (atomic rename) in the background
+        # even on the "sync" path — block so the artifact is durable, commit
+        with _spans.span("commit"):
+            getattr(ck, "wait_until_finished", lambda: None)()
+            if man is not None:
+                _write_manifest(man, path)
+        _prof().counter_inc("ckpt_saves")
+        if old:
+            shutil.rmtree(old, ignore_errors=True)
+            _remove_manifest(old)
+        return None
 
 
 def load_state_dict(
@@ -467,6 +478,8 @@ class AutoCheckpoint:
         (resume falls back to the previous verified checkpoint)."""
         from ..fault.retry import retry_call
 
+        from ..profiler import flight as _flight
+
         try:
             # a failed async background write from the PREVIOUS save surfaces
             # here — log it like any other lost save instead of killing the
@@ -474,6 +487,10 @@ class AutoCheckpoint:
             self.wait()
         except Exception as e:
             _prof().counter_inc("ckpt_save_failures")
+            _flight.dump(
+                "ckpt_save_failure",
+                extra={"step": step, "phase": "async_commit", "error": repr(e)},
+            )
             warnings.warn(f"previous async checkpoint save failed (skipped): {e!r}")
         try:
             pend = retry_call(
@@ -487,6 +504,10 @@ class AutoCheckpoint:
             )
         except Exception as e:
             _prof().counter_inc("ckpt_save_failures")
+            _flight.dump(
+                "ckpt_save_failure",
+                extra={"step": step, "phase": "write", "error": repr(e)},
+            )
             warnings.warn(f"checkpoint save at step {step} failed (skipped): {e!r}")
             return False
         self._pending = pend

@@ -311,7 +311,14 @@ class HybridParallelEngine:
 
     @no_grad()
     def train_step(self, *batch):
+        from ..profiler import spans as _spans
+
+        with _spans.span("train_step", kind="engine") as sp:
+            return self._train_step_impl(sp, *batch)
+
+    def _train_step_impl(self, sp, *batch):
         param_arrays, opt_state, batch_arrays, lr, key = self._prepare(*batch)
+        sp.set(wus=self._wus is not None, params=len(self.params))
         try:
             loss, new_params, new_state = self._jit(
                 param_arrays, opt_state, batch_arrays, lr, key

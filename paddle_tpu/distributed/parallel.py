@@ -138,36 +138,50 @@ class DataParallel(Layer):
             return tuple(plan.unflatten(b, out))
 
         from .. import profiler
+        from ..profiler import spans as _spans
 
         record_lazy = not live_axis and (
             lazy_mod.lazy_enabled() or any(lazy_mod.is_lazy(g) for g in grads)
         )
-        for b in plan.buckets:
-            b_params = [params[i] for i in b.indices]
-            b_grads = [grads[i] for i in b.indices]
-            if record_lazy:
-                outs, _ = lazy_mod.record(
-                    "dp_bucket_sync",
-                    lambda *a, _b=b: sync_bucket(_b, *a),
-                    list(b_grads),
-                    key=("dp_bucket_sync", plan.signature, b.key(), quant),
-                )
-                synced = outs
-            else:
-                synced = sync_bucket(b, *b_grads)
-            for p, g in zip(b_params, synced):
-                # rebind through the sync: _set_data marks the old grad
-                # buffer as a lazy-flush donation candidate
-                if isinstance(p.grad, Tensor):
-                    p.grad._set_data(g)
-                else:
-                    p.grad = Tensor(g, stop_gradient=True)
-        # dp_buckets counts bucketed sync operations (coalescing ran even at
-        # world 1); the collective-launch/wire counters only count real ones
-        profiler.counter_inc("dp_buckets", len(plan.buckets))
-        if n > 1:
-            profiler.counter_inc("dp_all_reduces", len(plan.buckets))
-            profiler.counter_inc("dp_sync_bytes", plan.sync_bytes("all_reduce", quant))
+        with _spans.span(
+            "dp_sync", buckets=len(plan.buckets), world=n, quantized=quant,
+            lazy=record_lazy,
+        ) as ssp:
+            for b in plan.buckets:
+                b_params = [params[i] for i in b.indices]
+                b_grads = [grads[i] for i in b.indices]
+                # per-bucket collective span: under the lazy engine this times
+                # the RECORD (the collective itself runs inside the fused
+                # flush); in a live shard_map trace it times the real launch
+                with _spans.span(
+                    "dp_bucket", bytes=b.padded * b.itemsize,
+                    params=len(b.indices), dtype=str(b.dtype),
+                ):
+                    if record_lazy:
+                        outs, _ = lazy_mod.record(
+                            "dp_bucket_sync",
+                            lambda *a, _b=b: sync_bucket(_b, *a),
+                            list(b_grads),
+                            key=("dp_bucket_sync", plan.signature, b.key(), quant),
+                        )
+                        synced = outs
+                    else:
+                        synced = sync_bucket(b, *b_grads)
+                for p, g in zip(b_params, synced):
+                    # rebind through the sync: _set_data marks the old grad
+                    # buffer as a lazy-flush donation candidate
+                    if isinstance(p.grad, Tensor):
+                        p.grad._set_data(g)
+                    else:
+                        p.grad = Tensor(g, stop_gradient=True)
+            # dp_buckets counts bucketed sync operations (coalescing ran even
+            # at world 1); collective-launch/wire counters only count real ones
+            profiler.counter_inc("dp_buckets", len(plan.buckets))
+            if n > 1:
+                sync_bytes = plan.sync_bytes("all_reduce", quant)
+                profiler.counter_inc("dp_all_reduces", len(plan.buckets))
+                profiler.counter_inc("dp_sync_bytes", sync_bytes)
+                ssp.set(sync_bytes=sync_bytes)
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)

@@ -118,12 +118,22 @@ class PreemptionGuard:
         return True  # only reached when exit_fn returns (tests)
 
     def drain(self, step: Optional[int] = None, state_dict=None) -> None:
-        """Flush the pending lazy graph and force a final synchronous
-        checkpoint (bypasses the save interval and async mode)."""
+        """Flush the pending lazy graph, write a flight-recorder post-mortem
+        (the preempted worker's last spans/counters survive the exit), and
+        force a final synchronous checkpoint (bypasses the save interval and
+        async mode)."""
         from ..core import lazy
 
         lazy.flush()
         _counter("preemption_drains")
+        try:
+            from ..profiler import flight
+
+            flight.dump(
+                "preemption", extra={"step": step, "signum": self._signum}
+            )
+        except Exception:
+            pass
         if self.checkpoint is not None and state_dict is not None and step is not None and step >= 0:
             self.checkpoint.save_now(step, state_dict, sync=True)
 

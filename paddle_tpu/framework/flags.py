@@ -50,6 +50,10 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_quantized_allreduce_error_feedback": False,
     # Gradient-bucket byte cap (reference DataParallel comm_buffer_size=25MB).
     "FLAGS_dp_bucket_bytes": 25 * 1024 * 1024,
+    # Per-flush live-buffer memory census (jax.live_arrays() walk feeding the
+    # profiler's live_bytes/peak gauges and lazy_flush span attrs) without a
+    # running Profiler; Profiler(profile_memory=True) turns it on per session.
+    "FLAGS_profile_memory": False,
     # JAX persistent compilation cache (warm executable starts across
     # processes). Dir defaults to ~/.cache/paddle_tpu/xla when unset.
     "FLAGS_xla_persistent_cache": True,

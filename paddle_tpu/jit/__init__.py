@@ -303,18 +303,10 @@ class CompiledTrainStep:
         self._jit = jax.jit(step_fn, donate_argnums=donate)
 
     def __call__(self, *batch):
-        from ..core.dispatch import _prof
+        from ..profiler import spans as _spans
 
-        p = _prof()
-        if p._enabled:
-            import time as _time
-
-            _t0 = _time.perf_counter_ns()
-            try:
-                return self._call_impl(*batch)
-            finally:
-                p._record("jit::train_step", _t0)
-        return self._call_impl(*batch)
+        with _spans.span("train_step", kind="jit"):
+            return self._call_impl(*batch)
 
     def _call_impl(self, *batch):
         if self._jit is None:

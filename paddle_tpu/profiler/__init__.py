@@ -1,15 +1,31 @@
 """Profiler.
 
 Parity: reference new profiler (``paddle/fluid/platform/profiler/`` —
-Profiler composes HostTracer + CudaTracer(CUPTI), chrome-trace export) and
-python API (``python/paddle/profiler/``). TPU-native: host events recorded in
-Python/C++ ring buffer; device timeline delegated to jax.profiler (XProf /
-tensorboard trace), the TPU equivalent of CUPTI.
+Profiler composes HostTracer + CudaTracer(CUPTI), chrome-trace export, stat
+aggregation) and python API (``python/paddle/profiler/``). TPU-native: host
+events + structured spans recorded in a Python/C++ ring buffer; device
+timeline delegated to jax.profiler (XProf / tensorboard trace), the TPU
+equivalent of CUPTI.
+
+Layers (each usable alone):
+
+* **engine counters** — always-on integer bumps at flush/step granularity
+  (:func:`counters`), exported as JSON or Prometheus text
+  (:mod:`.export`), folded into every ``bench.py`` JSON line;
+* **span tracer** (:mod:`.spans`) — nested, attributed spans
+  (``train_step`` → ``lazy_flush`` → ``trace``/``donate``/``compile``/
+  ``execute``; ``dp_sync`` → per-bucket; ``ckpt_save`` →
+  ``serialize``/``commit``) recorded while a :class:`Profiler` runs;
+* **flight recorder** (:mod:`.flight`) — always-on bounded ring of the last
+  N spans + a JSON post-mortem dump on NaN trips, preemption drains,
+  checkpoint-save failure, or an uncaught training-loop exception;
+* **memory accounting** — per-flush live-buffer census over
+  ``jax.live_arrays()`` with a high-water-mark gauge (:func:`memory_census`),
+  on under ``Profiler(profile_memory=True)`` or ``FLAGS_profile_memory``.
 """
 from __future__ import annotations
 
 import contextlib
-import json
 import os
 import time
 from typing import Dict, List, Optional
@@ -43,6 +59,7 @@ class _Event:
 
 _events: List[_Event] = []
 _enabled = False
+_memory_on = False  # set while a Profiler(profile_memory=True) session runs
 
 # Engine counters (always on — integer bumps at flush/step granularity, not
 # per-op): lazy-flush executable cache behavior and buffer donation. The
@@ -57,15 +74,18 @@ def counter_inc(name: str, n: int = 1):
 
 
 def counters() -> Dict[str, int]:
-    """Snapshot of engine counters: ``lazy_flushes``, ``lazy_cache_hits``,
-    ``lazy_donated_buffers``, ``lazy_donation_fallbacks`` (always on),
-    ``dispatch_fastkey_hits`` (per-op — only counted while the profiler is
-    running, to keep the dispatch hot path free of bookkeeping), and the
-    fault-tolerance set: ``ckpt_saves`` / ``ckpt_save_failures`` /
+    """Snapshot of engine counters.
+
+    Lazy engine (always on): ``lazy_flushes``, ``lazy_cache_hits``,
+    ``lazy_donated_buffers``, ``lazy_donation_fallbacks``;
+    ``dispatch_fastkey_hits`` is per-op and only counted while the profiler
+    is running, to keep the dispatch hot path free of bookkeeping.
+
+    Fault tolerance: ``ckpt_saves`` / ``ckpt_save_failures`` /
     ``ckpt_resume_fallbacks`` (crash-safe checkpointing),
     ``preemption_drains`` (PreemptionGuard SIGTERM drains),
     ``retry_attempts`` (fault/retry.py backoff retries), ``naninf_trips``
-    (lazy-mode FLAGS_check_nan_inf post-flush trips) and
+    (FLAGS_check_nan_inf trips, eager and lazy), and
     ``naninf_donation_suppressed`` (flushes that skipped buffer donation to
     keep pre-step state inspectable under the nan guard).
 
@@ -76,28 +96,89 @@ def counters() -> Dict[str, int]:
     FLAGS_quantized_allreduce is on), ``dp_gather_bytes`` (ZeRO-1
     updated-param all-gather, full precision), ``dp_buckets`` /
     ``dp_reduce_scatters`` / ``dp_all_reduces`` (collective launches), and
-    ``wus_enabled`` (1 when the engine runs the sharded weight update)."""
+    ``wus_enabled`` (1 when the engine runs the sharded weight update).
+
+    Telemetry: ``flight_dumps`` (flight-recorder post-mortems written by
+    this process).
+
+    Export: :func:`export_metrics` (JSON or Prometheus text) embeds this
+    snapshot plus the memory gauges; ``Profiler.export`` embeds it as
+    chrome-trace metadata; ``bench.py`` folds it into every BENCH JSON line.
+    """
     return dict(_counters)
 
 
 def reset_counters():
     _counters.clear()
 
+
+# -- memory accounting --------------------------------------------------------
+_mem: Dict[str, int] = {
+    "live_bytes": 0, "live_arrays": 0, "peak_live_bytes": 0,
+    "last_delta_bytes": 0, "censuses": 0,
+}
+
+
+def memory_census() -> Dict[str, int]:
+    """Walk ``jax.live_arrays()`` and refresh the gauges: current live
+    device-buffer bytes/count, the delta since the previous census, and the
+    process high-water mark. Called per lazy flush while memory profiling is
+    active; cheap enough to call directly at snapshot points (bench)."""
+    total = 0
+    count = 0
+    try:
+        for a in jax.live_arrays():
+            try:
+                total += int(a.nbytes)
+                count += 1
+            except Exception:
+                pass
+    except Exception:
+        return dict(_mem)
+    _mem["last_delta_bytes"] = total - _mem["live_bytes"]
+    _mem["live_bytes"] = total
+    _mem["live_arrays"] = count
+    _mem["censuses"] += 1
+    if total > _mem["peak_live_bytes"]:
+        _mem["peak_live_bytes"] = total
+    return dict(_mem)
+
+
+def memory_stats() -> Dict[str, int]:
+    """Last-census gauges WITHOUT a fresh walk (safe mid-crash)."""
+    return dict(_mem)
+
+
+def _memory_active() -> bool:
+    if _enabled and _memory_on:
+        return True
+    try:
+        from ..framework import flags as _flags
+
+        return bool(_flags.flag("FLAGS_profile_memory", False))
+    except Exception:
+        return False
+
+
 # Native host recorder (runtime_cpp/trace.cc) when built — GIL-cheap record.
 _native = None
 _native_rec = None
+_native_spans = False
+_native_tried = False
 
 
 def _native_recorder():
-    global _native, _native_rec
-    if _native_rec is not None:
+    global _native, _native_rec, _native_spans, _native_tried
+    if _native_rec is not None or _native_tried:
         return _native_rec
+    _native_tried = True
     try:
-        from ..core.native import lib
+        from ..core import native as _native_mod
 
-        _native = lib()
+        _native = _native_mod.lib()
         if _native is not None:
             _native_rec = _native.ptt_create(1 << 16)
+            _native_spans = bool(getattr(_native_mod, "HAS_SPANS", False))
     except Exception:
         _native = None
     return _native_rec
@@ -106,7 +187,9 @@ def _native_recorder():
 def _record(name: str, t0: int, tid: int = 0):
     """Hot-path event sink: dispatch/lazy/jit call this with a start stamp
     taken only when ``_enabled`` was already true (reference records every
-    traced op the same way, imperative/tracer.cc:177)."""
+    traced op the same way, imperative/tracer.cc:177). Events land in
+    exactly ONE sink — the C++ ring when built, else the Python list —
+    and ``export()``/``summary()`` merge the sinks."""
     t1 = time.perf_counter_ns()
     if not _enabled:
         return
@@ -114,12 +197,14 @@ def _record(name: str, t0: int, tid: int = 0):
     if rec is not None:
         nid = _native.ptt_intern(rec, name.encode())
         _native.ptt_record(rec, nid, tid, t0, t1)
-    _events.append(_Event(name, t0, t1, tid))
+    else:
+        _events.append(_Event(name, t0, t1, tid))
 
 
 class RecordEvent:
     """Reference: platform/profiler.h RecordEvent push/pop. Events land in
-    the C++ ring buffer when the native runtime is built."""
+    the C++ ring buffer when the native runtime is built (Python list
+    otherwise — one sink, merged at export)."""
 
     def __init__(self, name, event_type=None):
         self.name = name
@@ -130,12 +215,7 @@ class RecordEvent:
 
     def end(self):
         if _enabled and self._t0 is not None:
-            t1 = time.perf_counter_ns()
-            rec = _native_recorder()
-            if rec is not None:
-                nid = _native.ptt_intern(rec, self.name.encode())
-                _native.ptt_record(rec, nid, 0, self._t0, t1)
-            _events.append(_Event(self.name, self._t0, t1))
+            _record(self.name, self._t0)
 
     def __enter__(self):
         self.begin()
@@ -146,36 +226,147 @@ class RecordEvent:
         return False
 
 
+def _reset_session():
+    """Clear every session sink (python events, span list + attr table,
+    native rings) so a new recording starts from an empty timeline."""
+    _events.clear()
+    spans._reset_session()
+    rec = _native_recorder()
+    if rec is not None:
+        _native.ptt_reset(rec)
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Step-state schedule for ``Profiler.step()`` (reference
+    ``profiler.make_scheduler``): after ``skip_first`` warmup steps, cycle
+    through ``closed`` CLOSED steps, ``ready`` READY steps and ``record``
+    recording steps (the last of which is RECORD_AND_RETURN — the trace is
+    handed to ``on_trace_ready`` at the next ``step()``). ``repeat`` bounds
+    the number of cycles (0 = unlimited)."""
+    closed, ready, record = int(closed), int(ready), int(record)
+    repeat, skip_first = int(repeat), int(skip_first)
+    if record < 1:
+        raise ValueError("make_scheduler: record must be >= 1")
+    if min(closed, ready, repeat, skip_first) < 0:
+        raise ValueError("make_scheduler: negative phase length")
+    cycle = closed + ready + record
+
+    def schedule(step: int) -> int:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
 class Profiler:
-    def __init__(self, targets=None, scheduler=None, on_trace_ready=None, timer_only=False, record_shapes=False, profile_memory=False, with_flops=False):
+    """Host-span profiler with an optional step scheduler.
+
+    Without a scheduler, ``start()`` records until ``stop()`` (legacy
+    behavior). With ``scheduler=make_scheduler(...)``, call ``step()`` once
+    per train step: recording turns on only for the scheduled windows, and
+    ``on_trace_ready(prof)`` fires at the end of each RECORD_AND_RETURN
+    window (and at ``stop()`` if a window is still open)."""
+
+    def __init__(
+        self,
+        targets=None,
+        scheduler=None,
+        on_trace_ready=None,
+        timer_only=False,
+        record_shapes=False,
+        profile_memory=False,
+        with_flops=False,
+    ):
         self.timer_only = timer_only
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.profile_memory = profile_memory
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
         self._jax_tracing = False
         self._trace_dir = None
 
-    def start(self):
+    # -- state machine -----------------------------------------------------
+    def _recording(self) -> bool:
+        return self.current_state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+        )
+
+    def _apply(self, new_state: int):
         global _enabled
-        _enabled = True
-        _events.clear()
-        if not self.timer_only:
-            self._trace_dir = os.environ.get("PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
-            try:
-                jax.profiler.start_trace(self._trace_dir)
-                self._jax_tracing = True
-            except Exception:
+        was = self._recording()
+        self.current_state = new_state
+        now = self._recording()
+        if now and not was:
+            _enabled = True
+            if not self.timer_only and not self._jax_tracing:
+                self._trace_dir = os.environ.get(
+                    "PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace"
+                )
+                try:
+                    jax.profiler.start_trace(self._trace_dir)
+                    self._jax_tracing = True
+                except Exception:
+                    self._jax_tracing = False
+        elif was and not now:
+            _enabled = False
+            if self._jax_tracing:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
                 self._jax_tracing = False
 
+    def start(self):
+        global _memory_on
+        self.step_num = 0
+        _reset_session()
+        if self.profile_memory:
+            _memory_on = True
+        first = (
+            self.scheduler(0) if self.scheduler is not None else ProfilerState.RECORD
+        )
+        self._apply(first)
+
     def stop(self):
-        global _enabled
-        _enabled = False
-        if self._jax_tracing:
-            try:
-                jax.profiler.stop_trace()
-            except Exception:
-                pass
-            self._jax_tracing = False
+        global _memory_on
+        was = self._recording()
+        self._apply(ProfilerState.CLOSED)
+        if self.profile_memory:
+            _memory_on = False
+        if was and self.on_trace_ready is not None:
+            self.on_trace_ready(self)
 
     def step(self):
-        pass
+        """Advance the scheduler one train step. Drives the CLOSED → READY →
+        RECORD → RECORD_AND_RETURN transitions; when the step that just
+        finished was RECORD_AND_RETURN, the collected trace is handed to
+        ``on_trace_ready`` and the session buffers reset for the next
+        cycle."""
+        finished_window = self.current_state == ProfilerState.RECORD_AND_RETURN
+        self.step_num += 1
+        new = (
+            self.scheduler(self.step_num)
+            if self.scheduler is not None
+            else ProfilerState.RECORD
+        )
+        if finished_window:
+            self._apply(ProfilerState.CLOSED)
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+            _reset_session()
+        self._apply(new)
 
     def __enter__(self):
         self.start()
@@ -185,39 +376,70 @@ class Profiler:
         self.stop()
         return False
 
+    # -- output ------------------------------------------------------------
     def export(self, path, format="json"):
-        """Chrome-trace export (reference chrometracing_logger.cc)."""
-        trace = {
-            "traceEvents": [
-                {
-                    "name": e.name,
-                    "ph": "X",
-                    "ts": e.start / 1000.0,
-                    "dur": (e.end - e.start) / 1000.0,
-                    "pid": 0,
-                    "tid": e.tid,
-                }
-                for e in _events
-            ]
+        """Chrome-trace export (reference chrometracing_logger.cc) with the
+        engine-counter snapshot, memory gauges and flags embedded as trace
+        ``metadata`` (self-describing traces); ``format="jsonl"`` writes the
+        greppable one-object-per-line stream instead."""
+        from . import export as _export
+
+        if format in ("json", "chrome"):
+            _export.chrome_trace(path)
+        elif format in ("jsonl", "ndjson"):
+            _export.jsonl(path)
+        else:
+            raise ValueError(f"unknown export format {format!r}")
+
+    def summary(self, sorted_by="total", op_detail=True, thread_sep=False, time_unit="ms"):
+        """Aggregate table over events + spans: calls, total, avg, min, max
+        per name (reference profiler.summary shape). ``sorted_by`` one of
+        ``total``/``calls``/``avg``/``min``/``max``/``name`` (None =
+        total)."""
+        from . import export as _export
+
+        div = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}.get(time_unit, 1e6)
+        agg: Dict[str, list] = {}
+        rows = [
+            (e.name, e.end - e.start) for e in _export.merged_events()
+        ] + [
+            (s["name"], s["t1"] - s["t0"]) for s in _export.merged_spans()
+        ]
+        for name, dur in rows:
+            r = agg.get(name)
+            if r is None:
+                agg[name] = [1, dur, dur, dur]
+            else:
+                r[0] += 1
+                r[1] += dur
+                r[2] = min(r[2], dur)
+                r[3] = max(r[3], dur)
+
+        sorted_by = sorted_by or "total"
+        keys = {
+            "total": lambda kv: -kv[1][1],
+            "calls": lambda kv: -kv[1][0],
+            "avg": lambda kv: -(kv[1][1] / kv[1][0]),
+            "min": lambda kv: -kv[1][2],
+            "max": lambda kv: -kv[1][3],
+            "name": lambda kv: kv[0],
         }
-        with open(path, "w") as f:
-            json.dump(trace, f)
-
-    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
-        from collections import defaultdict
-
-        agg = defaultdict(lambda: [0, 0.0])
-        for e in _events:
-            agg[e.name][0] += 1
-            agg[e.name][1] += (e.end - e.start) / 1e6
-        lines = [f"{'name':40s} {'calls':>8s} {'total_ms':>12s}"]
-        for name, (calls, total) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
-            lines.append(f"{name:40s} {calls:8d} {total:12.3f}")
+        if sorted_by not in keys:
+            raise ValueError(
+                f"summary: unknown sorted_by {sorted_by!r}; expected one of "
+                f"{sorted(keys)}"
+            )
+        u = time_unit if time_unit in ("s", "ms", "us", "ns") else "ms"
+        lines = [
+            f"{'name':40s} {'calls':>8s} {'total_' + u:>12s} "
+            f"{'avg_' + u:>10s} {'min_' + u:>10s} {'max_' + u:>10s}"
+        ]
+        for name, (calls, total, mn, mx) in sorted(agg.items(), key=keys[sorted_by]):
+            lines.append(
+                f"{name:40s} {calls:8d} {total / div:12.3f} "
+                f"{total / calls / div:10.3f} {mn / div:10.3f} {mx / div:10.3f}"
+            )
         return "\n".join(lines)
-
-
-def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
-    return None
 
 
 @contextlib.contextmanager
@@ -228,3 +450,33 @@ def profiler_guard(**kwargs):
         yield p
     finally:
         p.stop()
+
+
+# Submodules import the package (counters/memory/_enabled), so they load
+# AFTER those definitions.
+from . import flight  # noqa: E402,F401
+from . import spans  # noqa: E402,F401
+from .spans import span  # noqa: E402,F401
+
+
+def events() -> List[_Event]:
+    """Merged flat-event view across sinks (Python list + native ring)."""
+    from . import export as _export
+
+    return _export.merged_events()
+
+
+def span_events() -> List[dict]:
+    """Merged finished-span view (dicts with ids, tid, times, attrs)."""
+    from . import export as _export
+
+    return _export.merged_spans()
+
+
+def export_metrics(path: Optional[str] = None, format: str = "json"):
+    """Counter + memory snapshot as JSON (default) or Prometheus text
+    exposition format; returns the serialized string (and writes it to
+    ``path`` when given)."""
+    from . import export as _export
+
+    return _export.export_metrics(path, format=format)

@@ -1,0 +1,225 @@
+"""Trace + metrics exporters.
+
+One merge point for the profiler's sinks (reference
+``chrometracing_logger.cc`` + ``profiler_statistic.cc``): flat events and
+spans each live in exactly ONE sink — the native C++ rings
+(``runtime_cpp/trace.cc``) when built, else the Python lists — and the
+functions here re-join them (span attributes ride a Python side table keyed
+by span id, since the native ring stores only the fixed-width record).
+
+Formats:
+
+* :func:`chrome_trace` — ``chrome://tracing`` / Perfetto JSON; spans are
+  complete ("X") events whose time containment per tid gives the nesting,
+  with ``span_id``/``parent_id``/attributes in ``args`` and the counter +
+  memory + flags snapshot in top-level ``metadata`` (self-describing trace);
+* :func:`jsonl` — greppable one-object-per-line stream (spans, events, then
+  a metrics record);
+* :func:`export_metrics` — counters + memory gauges as JSON or Prometheus
+  text exposition format.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import re
+import sys
+import time
+from typing import Dict, List, Optional
+
+_EVENT_BYTES = 24   # trace.cc Event: u32 name_id | u32 tid | u64 t0 | u64 t1
+_SPAN_BYTES = 40    # trace.cc SpanEvent: + u64 span_id | u64 parent_id
+_MAX_DRAIN = 1 << 16
+
+
+def _pkg():
+    return sys.modules[__package__]
+
+
+def _drain(kind: str) -> list:
+    """Copy the native ring out (non-destructive; the cursor keeps running —
+    ``Profiler.start()`` resets it per session). Returns [] without the
+    native runtime."""
+    m = _pkg()
+    rec = m._native_recorder()
+    if rec is None:
+        return []
+    if kind == "span" and not m._native_spans:
+        return []
+    import numpy as np
+
+    nbytes = _EVENT_BYTES if kind == "event" else _SPAN_BYTES
+    buf = ctypes.create_string_buffer(nbytes * _MAX_DRAIN)
+    if kind == "event":
+        n = m._native.ptt_drain(rec, buf, _MAX_DRAIN)
+        dt = np.dtype(
+            [("name_id", "<u4"), ("tid", "<u4"), ("t0", "<u8"), ("t1", "<u8")]
+        )
+    else:
+        n = m._native.ptt_span_drain(rec, buf, _MAX_DRAIN)
+        dt = np.dtype(
+            [
+                ("name_id", "<u4"), ("tid", "<u4"), ("t0", "<u8"),
+                ("t1", "<u8"), ("span_id", "<u8"), ("parent_id", "<u8"),
+            ]
+        )
+    if n <= 0:
+        return []
+    rows = np.frombuffer(buf, dtype=dt, count=int(n))
+    names: Dict[int, str] = {}
+
+    def name_of(nid: int) -> str:
+        s = names.get(nid)
+        if s is None:
+            raw = m._native.ptt_name(rec, nid)
+            s = raw.decode(errors="replace") if raw else f"name_{nid}"
+            names[nid] = s
+        return s
+
+    return [(name_of(int(r["name_id"])), r) for r in rows]
+
+
+def merged_events() -> list:
+    """Flat events across sinks as ``_Event`` objects, time-ordered."""
+    m = _pkg()
+    out = list(m._events)
+    for name, r in _drain("event"):
+        out.append(m._Event(name, int(r["t0"]), int(r["t1"]), int(r["tid"])))
+    out.sort(key=lambda e: e.start)
+    return out
+
+
+def merged_spans() -> List[dict]:
+    """Finished spans across sinks as dicts (attrs re-joined), time-ordered."""
+    m = _pkg()
+    attrs = m.spans._span_attrs
+    out = [sp.to_dict() for sp in m.spans._span_events]
+    for name, r in _drain("span"):
+        sid = int(r["span_id"])
+        out.append(
+            {
+                "name": name,
+                "span_id": sid,
+                "parent_id": int(r["parent_id"]),
+                "tid": int(r["tid"]),
+                "t0": int(r["t0"]),
+                "t1": int(r["t1"]),
+                "dur_us": (int(r["t1"]) - int(r["t0"])) / 1000.0,
+                "attrs": dict(attrs.get(sid, ())),
+            }
+        )
+    out.sort(key=lambda s: s["t0"])
+    return out
+
+
+def metrics_snapshot() -> dict:
+    """Counters + memory gauges + flags in effect (trace metadata payload)."""
+    m = _pkg()
+    try:
+        from ..framework.flags import _FLAGS
+
+        flags = dict(_FLAGS)
+    except Exception:
+        flags = {}
+    return {
+        "ts": time.time(),
+        "counters": m.counters(),
+        "memory": m.memory_stats(),
+        "flags": flags,
+    }
+
+
+def chrome_trace(path: str) -> None:
+    events = [
+        {
+            "name": e.name,
+            "ph": "X",
+            "cat": "op",
+            "ts": e.start / 1000.0,
+            "dur": (e.end - e.start) / 1000.0,
+            "pid": 0,
+            "tid": e.tid,
+        }
+        for e in merged_events()
+    ]
+    for s in merged_spans():
+        events.append(
+            {
+                "name": s["name"],
+                "ph": "X",
+                "cat": "span",
+                "ts": s["t0"] / 1000.0,
+                "dur": (s["t1"] - s["t0"]) / 1000.0,
+                "pid": 0,
+                "tid": s["tid"],
+                "args": {
+                    "span_id": s["span_id"],
+                    "parent_id": s["parent_id"],
+                    **s["attrs"],
+                },
+            }
+        )
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": metrics_snapshot(),
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, default=str)
+
+
+def jsonl(path: str) -> None:
+    """One JSON object per line: ``{"type": "span"|"event"|"metrics", ...}``
+    — greppable without a trace viewer (``grep lazy_flush trace.jsonl``)."""
+    with open(path, "w") as f:
+        for s in merged_spans():
+            f.write(json.dumps({"type": "span", **s}, default=str) + "\n")
+        for e in merged_events():
+            f.write(
+                json.dumps(
+                    {
+                        "type": "event",
+                        "name": e.name,
+                        "t0": e.start,
+                        "t1": e.end,
+                        "dur_us": (e.end - e.start) / 1000.0,
+                        "tid": e.tid,
+                    }
+                )
+                + "\n"
+            )
+        f.write(json.dumps({"type": "metrics", **metrics_snapshot()}, default=str) + "\n")
+
+
+# -- metrics ------------------------------------------------------------------
+_METRIC_NAME = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition format: every engine counter as a
+    ``counter``, every memory gauge as a ``gauge``, prefixed
+    ``paddle_tpu_``."""
+    m = _pkg()
+    lines = []
+    for name, val in sorted(m.counters().items()):
+        mn = "paddle_tpu_" + _METRIC_NAME.sub("_", name)
+        lines.append(f"# TYPE {mn} counter")
+        lines.append(f"{mn} {int(val)}")
+    for name, val in sorted(m.memory_stats().items()):
+        mn = "paddle_tpu_memory_" + _METRIC_NAME.sub("_", name)
+        lines.append(f"# TYPE {mn} gauge")
+        lines.append(f"{mn} {int(val)}")
+    return "\n".join(lines) + "\n"
+
+
+def export_metrics(path: Optional[str] = None, format: str = "json") -> str:
+    if format == "json":
+        text = json.dumps(metrics_snapshot(), default=str)
+    elif format in ("prometheus", "prom", "text"):
+        text = prometheus_text()
+    else:
+        raise ValueError(f"unknown metrics format {format!r}")
+    if path is not None:
+        with open(path, "w") as f:
+            f.write(text)
+    return text

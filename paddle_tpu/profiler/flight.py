@@ -1,0 +1,185 @@
+"""Flight recorder — always-on bounded span/counter history + crash dumps.
+
+A production fleet's first question after a dead worker is "what was it
+doing?". The reference answers it with log spew; here an always-on ring
+(``collections.deque(maxlen=N)`` of finished spans — one append per span, no
+allocation beyond the span itself) keeps the last N spans at near-zero cost,
+and :func:`dump` writes a JSON post-mortem containing:
+
+* the recent finished spans (with attributes) and the OPEN span stack of the
+  dumping thread (so a NaN trip names the producing ``lazy_flush`` span);
+* a full engine-counter snapshot (``profiler.counters()``) and memory gauges;
+* the pending lazy-graph summary (node count + tail op names);
+* the flags in effect and the arming state of fault injection.
+
+Triggers wired in this repo: the lazy-mode NaN/Inf guard (``naninf_trips``),
+``PreemptionGuard.drain``, checkpoint-save failure, and (opt-in via
+:func:`install_excepthook` or ``with flight.on_crash():``) any uncaught
+exception in a training loop.
+
+Env knobs:
+
+* ``PADDLE_TPU_FLIGHT_CAPACITY`` — ring size (default 256 spans).
+* ``PADDLE_TPU_FLIGHT_DIR`` — dump directory (default
+  ``<tmp>/paddle_tpu_flight``).
+* ``PADDLE_TPU_FLIGHT_DISABLE=1`` — turn the recorder off entirely.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "record", "dump", "last_dump", "recent_spans", "capacity", "enabled",
+    "install_excepthook", "on_crash", "clear",
+]
+
+_DISABLED = os.environ.get("PADDLE_TPU_FLIGHT_DISABLE", "").lower() in (
+    "1", "true", "yes",
+)
+try:
+    _CAPACITY = int(os.environ.get("PADDLE_TPU_FLIGHT_CAPACITY", "256") or 256)
+except ValueError:  # a malformed diagnostics knob must not take down import
+    _CAPACITY = 256
+_ring: "collections.deque" = collections.deque(maxlen=max(_CAPACITY, 8))
+_lock = threading.Lock()
+_last_dump: Optional[str] = None
+_dump_seq = itertools.count(1)  # same-millisecond dumps must not collide
+
+
+def enabled() -> bool:
+    return not _DISABLED
+
+
+def capacity() -> int:
+    return _ring.maxlen
+
+
+def record(sp) -> None:
+    """Hot-path sink: one bounded-deque append per finished span."""
+    if not _DISABLED:
+        _ring.append(sp)
+
+
+def recent_spans() -> list:
+    """Snapshot of the ring, oldest first."""
+    return list(_ring)
+
+
+def clear() -> None:
+    _ring.clear()
+
+
+def _dump_dir() -> str:
+    return os.environ.get("PADDLE_TPU_FLIGHT_DIR") or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_flight"
+    )
+
+
+def _pending_graph_summary() -> dict:
+    try:
+        from ..core import lazy
+
+        return lazy.pending_summary()
+    except Exception:
+        return {}
+
+
+def dump(reason: str, extra: Optional[dict] = None, path: Optional[str] = None) -> Optional[str]:
+    """Write the post-mortem JSON; returns its path (None when disabled or
+    the write itself failed — a crash dump must never mask the crash)."""
+    global _last_dump
+    if _DISABLED:
+        return None
+    from . import export as _export
+    from .spans import active_spans
+
+    try:
+        from ..fault import inject
+
+        fault_state = {"armed": inject.armed(), "fired": inject.fired_counts()}
+    except Exception:
+        fault_state = {}
+    doc = {
+        "reason": reason,
+        "pid": os.getpid(),
+        "active_spans": [sp.to_dict() for sp in active_spans()],
+        "recent_spans": [sp.to_dict() for sp in recent_spans()],
+        # one snapshot shape everywhere: traces, metrics export, crash dumps
+        **_export.metrics_snapshot(),
+        "pending_graph": _pending_graph_summary(),
+        "fault_inject": fault_state,
+        "extra": dict(extra or {}),
+    }
+    try:
+        if path is None:
+            d = _dump_dir()
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d,
+                f"flight_{os.getpid()}_{int(time.time() * 1000)}"
+                f"_{next(_dump_seq)}_{reason}.json",
+            )
+        with open(path, "w") as f:
+            json.dump(doc, f, default=str)
+    except Exception:
+        return None
+    with _lock:
+        _last_dump = path
+    from . import counter_inc
+
+    counter_inc("flight_dumps")
+    return path
+
+
+def last_dump() -> Optional[str]:
+    """Path of the most recent dump written by this process (tests)."""
+    return _last_dump
+
+
+# -- uncaught-exception hookup ------------------------------------------------
+class on_crash:
+    """``with flight.on_crash():`` around a training loop — dumps (reason
+    ``uncaught_exception``) before the exception propagates."""
+
+    def __init__(self, reason: str = "uncaught_exception"):
+        self.reason = reason
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None and not issubclass(
+            exc_type, (KeyboardInterrupt, SystemExit, GeneratorExit)
+        ):
+            dump(self.reason, extra={"exception": repr(exc)})
+        return False
+
+
+_hook_installed = False
+
+
+def install_excepthook() -> None:
+    """Chain a sys.excepthook that dumps on any uncaught exception (opt-in:
+    a library must not globally rewrite excepthook at import)."""
+    global _hook_installed
+    if _hook_installed:
+        return
+    prev = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        try:
+            if not issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
+                dump("uncaught_exception", extra={"exception": repr(exc)})
+        finally:
+            prev(exc_type, exc, tb)
+
+    sys.excepthook = hook
+    _hook_installed = True

@@ -1,0 +1,182 @@
+"""Structured span tracer — nested, attributed host spans.
+
+Reference parity: the new profiler composes HostTracer events into ONE
+timeline with parent/child structure (``paddle/fluid/platform/profiler/``
+HostEventRecorder + chrome-trace nesting). Here spans are the coarse-grained
+skeleton of a training step — ``train_step`` → ``lazy_flush`` →
+``trace``/``donate``/``compile``/``execute``, ``dp_sync`` → per-bucket
+collective, ``ckpt_save`` → ``serialize``/``commit`` — each carrying typed
+attributes (graph node count, executable-cache key + hit/miss, donated
+bytes, bucket bytes, fallback reason) so the single most important lazy-mode
+question — "did this step recompile, replay a cached executable, or stall on
+sync?" — is answerable from the trace.
+
+Two sinks, different lifetimes:
+
+* the **flight recorder** (:mod:`.flight`) receives every finished span,
+  always — a bounded deque append, so the disabled-path cost is near zero
+  (spans exist only at flush/step/save granularity, never per op);
+* the **profiler session** receives spans only while a
+  :class:`~paddle_tpu.profiler.Profiler` is recording — into the native span
+  ring (``runtime_cpp/trace.cc`` ``ptt_span_record``) when built, else a
+  Python list; attributes ride in a bounded side table keyed by span id and
+  are re-joined at export. Exactly ONE sink holds the timing record, so
+  ``export()`` never double-counts.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Span", "span", "current_span", "active_spans"]
+
+_ids = itertools.count(1)  # GIL-atomic enough; 0 means "no parent"
+_tls = threading.local()
+
+# Compact per-thread display ids (chrome traces want small ints, and
+# threading.get_ident() values are neither small nor stable across runs).
+_tid_map: Dict[int, int] = {}
+_tid_lock = threading.Lock()
+
+
+def _tid() -> int:
+    ident = threading.get_ident()
+    t = _tid_map.get(ident)
+    if t is None:
+        with _tid_lock:
+            t = _tid_map.setdefault(ident, len(_tid_map))
+    return t
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = []
+        _tls.stack = s
+    return s
+
+
+class Span:
+    """One finished (or in-flight) span. ``attrs`` is a plain dict the owner
+    may mutate until ``__exit__`` — e.g. the flush sets ``cache=hit/miss``
+    only after the executable-cache probe."""
+
+    __slots__ = ("name", "span_id", "parent_id", "tid", "t0", "t1", "attrs")
+
+    def __init__(self, name: str, **attrs):
+        self.name = name
+        self.span_id = next(_ids)
+        self.parent_id = 0
+        self.tid = 0
+        self.t0 = 0
+        self.t1 = 0
+        self.attrs = attrs
+
+    # -- context manager ---------------------------------------------------
+    def __enter__(self) -> "Span":
+        st = _stack()
+        self.parent_id = st[-1].span_id if st else 0
+        self.tid = _tid()
+        st.append(self)
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.t1 = time.perf_counter_ns()
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        elif self in st:  # mis-nested exit (generator teardown): repair
+            st.remove(self)
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        _emit(self)
+        return False
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    @property
+    def dur_ns(self) -> int:
+        return self.t1 - self.t0
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "tid": self.tid,
+            "t0": self.t0,
+            "t1": self.t1,
+            "dur_us": (self.t1 - self.t0) / 1000.0,
+            "attrs": dict(self.attrs),
+        }
+
+    def __repr__(self):
+        return (
+            f"Span({self.name!r}, id={self.span_id}, parent={self.parent_id}, "
+            f"dur_us={(self.t1 - self.t0) / 1000.0:.1f}, attrs={self.attrs})"
+        )
+
+
+def span(name: str, **attrs) -> Span:
+    """``with span("lazy_flush", nodes=n) as sp: ... sp.set(cache="hit")``"""
+    return Span(name, **attrs)
+
+
+def current_span() -> Optional[Span]:
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+def active_spans() -> List[Span]:
+    """The current thread's OPEN span stack, outermost first (post-mortem
+    dumps serialize this to name the span a failure happened inside)."""
+    return list(getattr(_tls, "stack", ()) or ())
+
+
+# -- session sink ------------------------------------------------------------
+# Python-side finished spans for the recording session (used when the native
+# span ring is unavailable). Attrs always live Python-side: the native ring
+# holds only (name_id, tid, t0, t1, span_id, parent_id).
+_span_events: List[Span] = []
+_span_attrs: Dict[int, dict] = {}  # span_id -> attrs (joined at export)
+_SPAN_ATTRS_MAX = 1 << 16  # matches the native ring capacity
+
+
+_pkg = None  # the parent package module, bound lazily (import-order safe)
+
+
+def _emit(sp: Span) -> None:
+    global _pkg
+    if _pkg is None:
+        import sys
+
+        _pkg = sys.modules[__package__]
+    _pkg.flight.record(sp)
+    if not _pkg._enabled:
+        return
+    rec = _pkg._native_recorder()
+    if rec is not None and _pkg._native_spans:
+        nid = _pkg._native.ptt_intern(rec, sp.name.encode())
+        _pkg._native.ptt_span_record(
+            rec, nid, sp.tid, sp.t0, sp.t1, sp.span_id, sp.parent_id
+        )
+        # the native record is timing-only; attrs ride this side table until
+        # export re-joins them by span id. Evict oldest when full: the ring
+        # keeps the NEWEST spans, so the table must age out the same way or
+        # post-wraparound spans export attr-less while dead spans pin dicts.
+        if sp.attrs:
+            if len(_span_attrs) >= _SPAN_ATTRS_MAX:
+                _span_attrs.pop(next(iter(_span_attrs)))
+            _span_attrs[sp.span_id] = dict(sp.attrs)
+    else:
+        _span_events.append(sp)  # Span carries its own attrs to export
+
+
+def _reset_session() -> None:
+    _span_events.clear()
+    _span_attrs.clear()

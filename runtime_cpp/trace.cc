@@ -21,9 +21,22 @@ struct Event {
   uint64_t t1;
 };
 
+// Structured span record: same fixed-width ring discipline as Event, plus
+// the span/parent ids the Python span tracer assigns — the C++ side stays a
+// dumb timing sink; nesting and attributes are reconstructed at export.
+struct SpanEvent {
+  uint32_t name_id;
+  uint32_t tid;
+  uint64_t t0;
+  uint64_t t1;
+  uint64_t span_id;
+  uint64_t parent_id;
+};
+
 class Recorder {
  public:
-  explicit Recorder(size_t capacity) : events_(capacity), cursor_(0) {}
+  explicit Recorder(size_t capacity)
+      : events_(capacity), cursor_(0), spans_(capacity), span_cursor_(0) {}
 
   uint32_t InternName(const char* name) {
     std::lock_guard<std::mutex> lk(mu_);
@@ -49,16 +62,36 @@ class Recorder {
     return static_cast<int64_t>(count);
   }
 
+  void RecordSpan(uint32_t name_id, uint32_t tid, uint64_t t0, uint64_t t1,
+                  uint64_t span_id, uint64_t parent_id) {
+    size_t i = span_cursor_.fetch_add(1, std::memory_order_relaxed) % spans_.size();
+    spans_[i] = SpanEvent{name_id, tid, t0, t1, span_id, parent_id};
+  }
+
+  int64_t DrainSpans(SpanEvent* out, size_t n) {
+    size_t total = span_cursor_.load(std::memory_order_relaxed);
+    size_t avail = total < spans_.size() ? total : spans_.size();
+    size_t count = avail < n ? avail : n;
+    for (size_t k = 0; k < count; ++k)
+      out[k] = spans_[(total - avail + k) % spans_.size()];
+    return static_cast<int64_t>(count);
+  }
+
   const char* Name(uint32_t id) {
     std::lock_guard<std::mutex> lk(mu_);
     return id < names_.size() ? names_[id].c_str() : "";
   }
 
-  void Reset() { cursor_.store(0); }
+  void Reset() {
+    cursor_.store(0);
+    span_cursor_.store(0);
+  }
 
  private:
   std::vector<Event> events_;
   std::atomic<size_t> cursor_;
+  std::vector<SpanEvent> spans_;
+  std::atomic<size_t> span_cursor_;
   std::vector<std::string> names_;
   std::unordered_map<std::string, uint32_t> name_ids_;
   std::mutex mu_;
@@ -92,6 +125,20 @@ int64_t ptt_drain(void* r, uint8_t* out, int64_t max_events) {
   std::vector<Event> tmp(static_cast<size_t>(max_events));
   int64_t n = static_cast<Recorder*>(r)->Drain(tmp.data(), tmp.size());
   std::memcpy(out, tmp.data(), static_cast<size_t>(n) * sizeof(Event));
+  return n;
+}
+
+void ptt_span_record(void* r, uint32_t name_id, uint32_t tid, uint64_t t0,
+                     uint64_t t1, uint64_t span_id, uint64_t parent_id) {
+  static_cast<Recorder*>(r)->RecordSpan(name_id, tid, t0, t1, span_id, parent_id);
+}
+
+// out layout per span: name_id u32 | tid u32 | t0 u64 | t1 u64 | span_id u64
+// | parent_id u64 (40 bytes)
+int64_t ptt_span_drain(void* r, uint8_t* out, int64_t max_spans) {
+  std::vector<SpanEvent> tmp(static_cast<size_t>(max_spans));
+  int64_t n = static_cast<Recorder*>(r)->DrainSpans(tmp.data(), tmp.size());
+  std::memcpy(out, tmp.data(), static_cast<size_t>(n) * sizeof(SpanEvent));
   return n;
 }
 

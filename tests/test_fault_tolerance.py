@@ -11,6 +11,7 @@ Proves the ISSUE-2 acceptance criteria on CPU:
 * the fault-injection harness itself (deterministic firing, retry backoff),
   with a tripwire asserting every registered injection point is exercised.
 """
+import json
 import os
 import pathlib
 import warnings
@@ -20,6 +21,7 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import profiler
+from paddle_tpu.profiler import flight
 from paddle_tpu.core.lazy import is_lazy, lazy_guard
 from paddle_tpu.distributed.checkpoint import (
     AutoCheckpoint, CheckpointError, load_state_dict, read_manifest,
@@ -316,6 +318,58 @@ class TestLazyNanInfGuard:
         w = _fresh_w()
         with pytest.raises(FloatingPointError):
             _train_step(w, 0)
+
+
+# -- flight recorder: post-mortems on the fault paths --------------------------
+class TestFlightRecorderDumps:
+    def test_nan_trip_dumps_naming_producing_flush_span(self, tmp_path, monkeypatch):
+        """ISSUE-5 acceptance: an injected NaN fault produces a flight dump
+        whose active-span stack names the producing lazy_flush span, with
+        the last >=32 spans and a full counter snapshot."""
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        flight.clear()
+        w = _fresh_w()
+        for step in range(10):  # populate the ring: >=3 spans per step
+            _train_step(w, step)
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        inject.arm({"tensor.nan": {"op": "matmul", "call": 1}})
+        with pytest.raises(FloatingPointError):
+            _train_step(w, 10)
+        path = flight.last_dump()
+        assert path is not None and path.startswith(str(tmp_path))
+        doc = json.load(open(path))
+        assert doc["reason"] == "naninf"
+        # the dumping thread was INSIDE the flush: the open-span stack names it
+        assert any(s["name"] == "lazy_flush" for s in doc["active_spans"])
+        assert len(doc["recent_spans"]) >= 32
+        assert doc["counters"].get("naninf_trips", 0) >= 1
+        assert doc["counters"].get("lazy_flushes", 0) >= 10
+        assert doc["extra"]["origin"].startswith("lazy")
+        assert doc["fault_inject"]["armed"] is True
+
+    def test_preemption_drain_dumps(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        guard = PreemptionGuard(exit_fn=lambda code: None)
+        guard.preempt()
+        assert guard.check(7, None)
+        doc = json.load(open(flight.last_dump()))
+        assert doc["reason"] == "preemption"
+        assert doc["extra"]["step"] == 7
+        assert "preemption_drains" in doc["counters"]
+
+    def test_ckpt_save_failure_dumps(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path / "fl"))
+        ac = AutoCheckpoint(str(tmp_path / "auto"), interval_steps=1, save_retries=0)
+        inject.arm({"ckpt.write": {}})  # every write fails
+        w = _fresh_w()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert ac.maybe_save(1, {"w": w}) is False
+        doc = json.load(open(flight.last_dump()))
+        assert doc["reason"] == "ckpt_save_failure"
+        assert doc["extra"]["step"] == 1 and doc["extra"]["phase"] == "write"
+        assert "InjectedFault" in doc["extra"]["error"]
+        assert doc["counters"].get("ckpt_save_failures", 0) >= 1
 
 
 # -- retry + elastic ----------------------------------------------------------
