@@ -60,6 +60,22 @@ def _alarm(seconds):
         signal.signal(signal.SIGALRM, prev)
 
 
+def _gap_probe():
+    """Dispatch-gap instrumentation (ROADMAP item 2): host idle time between
+    device steps, measured as the attributed block time (lazy_block_ns —
+    every sanctioned host wait on the device feeds it) per timed step.
+    Returns finish(steps) -> ms/step."""
+    from paddle_tpu import profiler
+
+    c0 = profiler.counters().get("lazy_block_ns", 0)
+
+    def finish(steps):
+        c1 = profiler.counters().get("lazy_block_ns", 0)
+        return round((c1 - c0) / max(steps, 1) / 1e6, 3)
+
+    return finish
+
+
 def bench_gpt(paddle, jax, np, on_tpu):
     from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
 
@@ -118,6 +134,7 @@ def bench_gpt(paddle, jax, np, on_tpu):
     loss = step(ids, labels)
     float(loss.item())
 
+    gap = _gap_probe()
     t0 = time.time()
     for _ in range(steps):
         loss = step(ids, labels)
@@ -134,6 +151,7 @@ def bench_gpt(paddle, jax, np, on_tpu):
         "tokens_per_sec": round(tokens_per_sec, 1),
         "loss": round(final, 4),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "dispatch_gap_ms_per_step": gap(steps),
     }
 
 
@@ -383,6 +401,7 @@ def bench_lenet_eager(paddle, jax, np, on_tpu):
 
     one_step()
     one_step()
+    gap = _gap_probe()
     t0 = time.time()
     for _ in range(steps):
         loss = one_step()
@@ -391,6 +410,7 @@ def bench_lenet_eager(paddle, jax, np, on_tpu):
     return {
         "name": "LeNet eager train (b64, lazy batched dispatch)",
         "steps_per_sec": round(steps / dt, 2),
+        "dispatch_gap_ms_per_step": gap(steps),
     }
 
 
@@ -736,6 +756,17 @@ def main():
     except Exception:
         counters, memory = {}, {}
 
+    # dispatch-gap (ROADMAP item 2): host idle per device step — the primary
+    # fused-step loop's measured block time, falling back to the lazy
+    # (LeNet) loop's when the primary died
+    gap = gpt.get("dispatch_gap_ms_per_step")
+    if gap is None:
+        gap = next(
+            (e.get("dispatch_gap_ms_per_step") for e in extras
+             if e.get("dispatch_gap_ms_per_step") is not None),
+            None,
+        )
+
     print(
         json.dumps(
             {
@@ -745,6 +776,7 @@ def main():
                 "vs_baseline": round(vs_baseline, 3) if vs_baseline is not None else None,
                 "loss": gpt["loss"],
                 "mfu": gpt["mfu"],
+                "dispatch_gap_ms_per_step": gap,
                 "platform": jax.devices()[0].platform,
                 "wall_s": round(time.time() - t_start, 1),
                 **({"error": gpt["error"]} if gpt.get("error") else {}),

@@ -85,18 +85,37 @@ _code_key_cache: dict = {}
 
 def _fast_fn_key(fn):
     try:
-        if fn.__closure__ is None and not fn.__defaults__ and not fn.__kwdefaults__:
-            code = fn.__code__
-            k = _code_key_cache.get(code)
-            if k is None:
-                k = _fn_key(fn)
-                if len(_code_key_cache) > _JIT_CACHE_MAX:
-                    _code_key_cache.clear()  # exec/notebook-generated code objects
-                _code_key_cache[code] = k
-            elif _profiler is not None and _profiler._enabled:
-                _profiler.counter_inc("dispatch_fastkey_hits")
-            return k
-    except AttributeError:
+        cells = fn.__closure__
+        if not fn.__defaults__ and not fn.__kwdefaults__:
+            if cells is None:
+                code = fn.__code__
+                k = _code_key_cache.get(code)
+                if k is None:
+                    k = _fn_key(fn)
+                    if len(_code_key_cache) > _JIT_CACHE_MAX:
+                        _code_key_cache.clear()  # exec/notebook-generated code objects
+                    _code_key_cache[code] = k
+                elif _profiler is not None and _profiler._enabled:
+                    _profiler.counter_inc("dispatch_fastkey_hits")
+                return k
+            # Call-site memo, scalar-closure shape (the common op lambda
+            # `lambda *xs: fn(*xs, attr=v)` closing over attr values): build
+            # the key inline, skipping _fn_key's getattr chain + kwdefault
+            # sort. MUST stay value-compatible with _fn_key's output —
+            # scalars as (typename, value), strings verbatim — so both paths
+            # hash a given fn to the same executable-cache entry.
+            vals = []
+            for c in cells:
+                v = c.cell_contents
+                t = type(v)
+                if t in (bool, int, float, complex):
+                    vals.append((t.__name__, v))
+                elif t is str:
+                    vals.append(v)
+                else:
+                    return _fn_key(fn)
+            return (fn.__code__, tuple(vals), (), ())
+    except (AttributeError, ValueError):
         pass
     return _fn_key(fn)
 
@@ -128,7 +147,7 @@ def _get_jitted(fn, attrs):
     return jf
 
 
-def _nonfinite_error(name, idx, arr, origin="eager", hint=False):
+def _nonfinite_error(name, idx, arr, origin="eager", hint=False, extra=None):
     """Build the FLAGS_check_nan_inf diagnostic (reference
     nan_inf_utils_detail.cc prints tensor meta + offending values): which
     output, its shape/dtype, how many non-finite elements, and where the
@@ -151,8 +170,10 @@ def _nonfinite_error(name, idx, arr, origin="eager", hint=False):
         )
     # Every non-finite diagnostic (eager, lazy flush, per-op replay) writes a
     # flight-recorder post-mortem BEFORE the raise: the dump's active-span
-    # stack names the producing flush span, and recent spans + counters show
-    # what the engine was doing when the value went bad.
+    # stack names the producing flush span (for a DEFERRED async-mode trip
+    # the flush span is already closed, so `extra` carries it instead), and
+    # recent spans + counters show what the engine was doing when the value
+    # went bad.
     try:
         from ..profiler import flight
 
@@ -161,7 +182,7 @@ def _nonfinite_error(name, idx, arr, origin="eager", hint=False):
             extra={
                 "op": name, "output": idx, "origin": origin,
                 "nonfinite_count": cnt, "first_flat_index": flat_idx,
-                "message": msg,
+                "message": msg, **(extra or {}),
             },
         )
     except Exception:

@@ -1,4 +1,4 @@
-"""Lazy eager-op batching (LazyTensor engine).
+"""Lazy eager-op batching (LazyTensor engine) with an async runtime.
 
 TPU-native answer to the reference's per-op dispatch engineering
 (``paddle/fluid/imperative/tracer.cc:170`` hot loop +
@@ -16,13 +16,40 @@ Design:
     (``__array__``, unknown attribute) forces a flush.
   * ``record(name, fn, inputs)`` — append one node; output avals come from a
     cached ``jax.eval_shape`` probe, so shape/dtype errors still surface at
-    the op call site like eager mode.
-  * ``flush()`` — topologically replay the pending nodes inside ``jax.jit``.
-    The executable cache is keyed on the graph *signature* (per-node fn
-    identity incl. closure values, input wiring, liveness mask), so the
-    second identical iteration reuses the compiled step.
+    the op call site like eager mode. The wiring descriptors, leaf table and
+    signature parts are built HERE, incrementally — the flush no longer walks
+    the whole graph again, so per-step host work on cache hits is one
+    liveness sweep plus a dict probe.
+  * ``flush()`` — replay the pending nodes inside ``jax.jit``. The executable
+    cache is keyed on the graph *signature* (per-node fn identity incl.
+    closure values, input wiring, leaf avals, liveness mask, donation mask),
+    so the second identical iteration reuses the compiled step.
   * autograd defers ``jax.vjp`` into the graph (vjp composes under tracing),
     so backward is recorded, not executed, until the next materialization.
+
+Async runtime (``FLAGS_lazy_async``, default ON — arXiv:2102.13267's point:
+overlap host graph construction with device execution):
+
+  * the flush returns as soon as the fused executable is DISPATCHED; results
+    land in ``LazyArray._concrete`` as unblocked ``jax.Array`` futures, and
+    the host traces step k+1 while the device executes step k. Host waits are
+    instrumented: ``timed_block`` (called by ``Tensor.numpy()`` and
+    ``LazyArray.__array__``) emits a ``block`` span and feeds the
+    ``lazy_block_ns`` counter — the dispatch-gap metric in bench.py.
+  * the FLAGS_check_nan_inf scan and the telemetry memory census move off the
+    critical path: they are enqueued against the dispatched arrays and run at
+    the next flush, the next materialization, or :func:`sync` — the trip
+    surfaces at most one step late, with the producing ``lazy_flush`` span
+    attribution preserved in the flight-recorder dump. Donation stays
+    suppressed while the guard is armed (pre-step state survives, PR 2).
+  * ``FLAGS_lazy_bg_compile`` (opt-in): an executable-cache miss compiles on
+    a background thread while the current step completes via the un-jitted
+    replay, so new-shape warmup no longer stalls the loop. Opt-in because the
+    unfused replay can differ from the fused executable by ~1 ulp, and WHEN
+    the compiled executable is picked up depends on compile latency — loops
+    that pin bitwise reproducibility across runs must leave it off.
+  * ``FLAGS_lazy_async=0`` restores the fully synchronous PR-2 behavior:
+    in-flush NaN scan, in-flush census, no block instrumentation.
 
 Correctness fallback: if jitted replay fails, nodes run eagerly one-by-one.
 
@@ -37,6 +64,7 @@ from __future__ import annotations
 import collections
 import sys
 import threading
+import time
 import warnings
 import weakref
 from typing import Any, Callable, List, Optional, Sequence
@@ -46,9 +74,9 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
-    "LazyArray", "record", "flush", "lazy_enabled", "set_lazy_mode",
+    "LazyArray", "record", "flush", "sync", "lazy_enabled", "set_lazy_mode",
     "lazy_guard", "is_lazy", "maybe_lazy_binary", "lazy_full",
-    "note_rebound",
+    "note_rebound", "timed_block",
 ]
 
 _state = threading.local()
@@ -98,7 +126,7 @@ def concrete(x):
 
 
 class _Node:
-    __slots__ = ("key", "fn", "inputs", "n_out", "out_refs")
+    __slots__ = ("key", "fn", "inputs", "n_out", "out_refs", "gix", "graph")
 
     def __init__(self, key, fn, inputs, n_out):
         self.key = key
@@ -106,6 +134,8 @@ class _Node:
         self.inputs = inputs  # LazyArray | jax.Array | np scalar
         self.n_out = n_out
         self.out_refs = None  # list of weakrefs to output LazyArrays
+        self.gix = 0  # index in its graph's node list (wiring descriptor)
+        self.graph = None  # owning _Graph while pending; None once flushed
 
 
 class LazyArray:
@@ -152,13 +182,16 @@ class LazyArray:
             flush()
         if self._concrete is None:  # node died before flush (shouldn't happen)
             raise RuntimeError("LazyArray was never materialized")
+        # a deferred NaN/Inf check against THIS flush must surface here, at
+        # the materialization point, not one step later
+        _drain_deferred()
         return self._concrete
 
     def __jax_array__(self):
         return self._value()
 
     def __array__(self, dtype=None):
-        a = np.asarray(self._value())
+        a = np.asarray(timed_block(self._value()))
         return a.astype(dtype) if dtype is not None else a
 
     def __getattr__(self, name):
@@ -268,10 +301,24 @@ class LazyArray:
 
 
 class _Graph:
-    __slots__ = ("nodes",)
+    """One pending-graph epoch. The trace structures the old flush used to
+    rebuild per step — wiring descriptors, the deduped leaf table, donation
+    refcount bookkeeping, signature parts — are maintained INCREMENTALLY by
+    ``record``, so a cache-hit flush only sweeps output liveness."""
+
+    __slots__ = (
+        "nodes", "leaves", "leaf_pos", "leaf_avals", "direct_uses",
+        "descs", "keyparts",
+    )
 
     def __init__(self):
         self.nodes: List[_Node] = []
+        self.leaves: list = []  # deduped external inputs, in first-use order
+        self.leaf_pos: dict = {}  # id(leaf) -> index in `leaves`
+        self.leaf_avals: list = []  # per-leaf (shape, dtype, kind) sig parts
+        self.direct_uses: dict = {}  # id(leaf) -> occurrences in node inputs
+        self.descs: list = []  # per-node wiring descriptor tuples
+        self.keyparts: list = []  # per-node (node.key, descs) signature parts
 
 
 def _graph() -> _Graph:
@@ -336,25 +383,26 @@ def _ignore_donation_warnings():
         _donation_warnings_filtered = True
 
 
-def _donation_mask(leaves, cand, direct_uses, via_lazy):
+def _donation_mask(leaves, cand, direct_uses):
     """Leaf positions provably dead after this flush: marked as rebound AND
     the only strong references left are the pending graph's own input lists.
     Runs in its own frame so the caller's loop variables can't inflate the
-    refcount of the leaf under test."""
+    refcount of the leaf under test. A leaf still reachable through a live
+    LazyArray is protected automatically: that LazyArray's ``_concrete``
+    reference inflates the refcount past the graph-only budget."""
     out = []
     for j in range(len(leaves)):
         x = leaves[j]
         i = id(x)
         if (
             i not in cand
-            or i in via_lazy  # still reachable via a (possibly live) LazyArray
             or not isinstance(x, jax.Array)
             or isinstance(x, jax.core.Tracer)
         ):
             x = None
             continue
         # Refcount at this point for a dead buffer: one per occurrence in a
-        # node's input list, plus the flush `leaves` list, the loop binding
+        # node's input list, plus the graph `leaves` list, the loop binding
         # `x`, and getrefcount's own argument. Anything above that is a live
         # Tensor / user alias / residual capture — donation would corrupt it.
         if sys.getrefcount(x) == direct_uses.get(i, 0) + 3:
@@ -366,19 +414,40 @@ def _donation_mask(leaves, cand, direct_uses, via_lazy):
 # -- aval probing (cached) ---------------------------------------------------
 _aval_cache: dict = {}
 _AVAL_CACHE_MAX = 8192
+_sds_cache: dict = {}  # (shape, dtype) -> ShapeDtypeStruct (records are hot)
 
 
 def _aval_of(x):
     if isinstance(x, LazyArray):
-        return jax.ShapeDtypeStruct(tuple(x.aval.shape), x.aval.dtype)
+        return x.aval  # already a ShapeDtypeStruct from the probe
     if isinstance(x, jax.Array):
-        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        k = (x.shape, x.dtype)
+        s = _sds_cache.get(k)
+        if s is None:
+            if len(_sds_cache) > _AVAL_CACHE_MAX:
+                _sds_cache.clear()
+            s = _sds_cache[k] = jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        return s
     a = np.asarray(x)
     return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
 
+def _leaf_sig(x):
+    """Per-leaf signature component: shape/dtype (+ python-scalar typing —
+    a plain float traces weakly typed, an np.float32 doesn't). Folding these
+    into the flush signature keeps one cache entry per real trace, which the
+    AOT background-compile path requires (a compiled executable, unlike
+    jax.jit, cannot silently re-trace on a dtype change)."""
+    if isinstance(x, jax.Array):
+        return (x.shape, x.dtype)
+    if isinstance(x, (bool, int, float, complex)):
+        return type(x).__name__
+    a = np.asarray(x)
+    return (a.shape, a.dtype)
+
+
 def _probe(key, fn, in_avals):
-    ck = (key, tuple((a.shape, str(a.dtype)) for a in in_avals))
+    ck = (key, tuple((a.shape, a.dtype) for a in in_avals))
     try:
         hash(ck)
     except TypeError:
@@ -436,20 +505,66 @@ def record(name, fn, inputs, key=None):
     ``(outputs: list[LazyArray], single: bool)``. ``key`` identifies fn for
     the executable cache; when None it is derived from fn's code + closure
     values (correct as long as the closure holds only hashables).
+
+    The wiring descriptor, leaf-table entries and signature part for the node
+    are built here — incremental tracing — so ``flush`` does not re-walk the
+    graph (tentpole of the async runtime: host work per cache-hit step is a
+    liveness sweep + executable-cache probe + dispatch).
     """
     g = _graph()
+    leaf_pos = g.leaf_pos
+    leaves = g.leaves
     ins = []
+    descs = []
+    # Leaf-table/direct_uses mutations are staged and committed only after
+    # _probe succeeds: a caught shape/dtype error from eval_shape must leave
+    # the pending graph exactly as it was (an orphan leaf would perturb the
+    # flush signature and overcount direct_uses, breaking the donation mask).
+    new_leaves = []  # (x, leaf_sig) in reservation order
+    new_pos = {}
+    du_bump = {}
     for x in inputs:
-        if isinstance(x, LazyArray) and x._concrete is not None:
+        if isinstance(x, LazyArray):
+            if x._concrete is None:
+                n = x._node
+                if n.graph is not g:
+                    raise RuntimeError(
+                        "lazy graph invariant violated: input from a "
+                        "flushed-but-unmaterialized node"
+                    )
+                ins.append(x)
+                descs.append(("n", n.gix, x._idx))
+                continue
             x = x._concrete
+        j = leaf_pos.get(id(x))
+        if j is None:
+            j = new_pos.get(id(x))
+            if j is None:
+                j = len(leaves) + len(new_leaves)
+                new_pos[id(x)] = j
+                new_leaves.append((x, _leaf_sig(x)))
+        du_bump[id(x)] = du_bump.get(id(x), 0) + 1
         ins.append(x)
+        descs.append(("l", j))
     in_avals = [_aval_of(x) for x in ins]
     k = key if key is not None else _fn_key(fn)
     avals, single = _probe((name, k), fn, in_avals)
+    for x, sig in new_leaves:
+        leaf_pos[id(x)] = len(leaves)
+        leaves.append(x)
+        g.leaf_avals.append(sig)
+    du = g.direct_uses
+    for ident, c in du_bump.items():
+        du[ident] = du.get(ident, 0) + c
     node = _Node((name, k), fn, ins, len(avals))
+    node.gix = len(g.nodes)
+    node.graph = g
     outs = [LazyArray(node, i, a) for i, a in enumerate(avals)]
     node.out_refs = [weakref.ref(o) for o in outs]
     g.nodes.append(node)
+    descs = tuple(descs)
+    g.descs.append(descs)
+    g.keyparts.append((node.key, descs))
     if len(g.nodes) >= _MAX_PENDING:
         flush()
     return outs, single
@@ -494,6 +609,12 @@ def _spans():
     return _spans_mod
 
 
+def _flags_mod():
+    from ..framework import flags
+
+    return flags
+
+
 def pending_summary() -> dict:
     """Post-mortem view of this thread's pending graph (flight recorder):
     node count and the tail of op names awaiting execution."""
@@ -502,19 +623,174 @@ def pending_summary() -> dict:
     return {
         "pending_nodes": len(nodes),
         "tail_ops": [n.key[0] for n in nodes[-8:]],
+        # census-only entries (payload None) carry no NaN/Inf scan — a dump
+        # must not claim a check was pending when only a census was
+        "deferred_checks": sum(
+            1 for e in (getattr(_state, "deferred", ()) or ()) if e[1] is not None
+        ),
     }
+
+
+# -- async runtime: host-wait instrumentation & deferred post-flush work -----
+def _timed_block(x, where: str):
+    """Block until ``x`` is ready under a ``block`` span, feeding the
+    dispatch-gap counters (``lazy_blocks`` / ``lazy_block_ns``). This is the
+    ONLY sanctioned way the runtime waits on the device — the tier-1
+    tripwire asserts no ``block`` span ever appears inside ``lazy_flush``."""
+    from .dispatch import _prof
+
+    t0 = time.perf_counter_ns()
+    with _spans().span("block", where=where):
+        jax.block_until_ready(x)
+    p = _prof()
+    p.counter_inc("lazy_blocks")
+    p.counter_inc("lazy_block_ns", time.perf_counter_ns() - t0)
+    return x
+
+
+def timed_block(x, where: str = "readback"):
+    """Public wrapper used at host readback sites (``Tensor.numpy()``,
+    ``LazyArray.__array__``, metric updates): waits for an in-flight
+    ``jax.Array`` (or a sequence of them) with the wait ATTRIBUTED (block
+    span + lazy_block_ns), so host idle time between device steps is
+    measurable instead of hiding inside ``np.asarray``. Identity for ready
+    arrays, non-arrays, tracers, and when ``FLAGS_lazy_async`` is off (the
+    old behavior blocked silently)."""
+    if isinstance(x, (list, tuple)):
+        arrs = [
+            a for a in x
+            if isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer)
+        ]
+        if not arrs or not _flags_mod().flag("FLAGS_lazy_async", True):
+            return x
+        try:
+            if all(a.is_ready() for a in arrs):
+                return x
+        except Exception:
+            pass
+        _timed_block(arrs, where)
+        return x
+    if not isinstance(x, jax.Array) or isinstance(x, jax.core.Tracer):
+        return x
+    if not _flags_mod().flag("FLAGS_lazy_async", True):
+        return x
+    try:
+        if x.is_ready():  # committed futures skip the span entirely
+            return x
+    except Exception:
+        pass
+    return _timed_block(x, where)
+
+
+def _enqueue_deferred(sp, check_payload, census, results):
+    d = getattr(_state, "deferred", None)
+    if d is None:
+        d = []
+        _state.deferred = d
+    d.append((sp, check_payload, census, results))
+
+
+def _drain_deferred():
+    """Run the post-flush work deferred off the critical path: the memory
+    census (attrs attached to the PRODUCING lazy_flush span post-hoc) and
+    the NaN/Inf scan — which blocks on the dispatched arrays under a
+    ``block`` span and raises with the producing-span attribution intact.
+    Called at flush entry, at every materialization point, and by sync()."""
+    d = getattr(_state, "deferred", None)
+    if not d:
+        return
+    entries = list(d)
+    del d[:]  # reentrancy/raise-safe: one trip drops the batch
+    spans_mod = _spans()
+    for sp, payload, census, results in entries:
+        if census:
+            from .dispatch import _prof
+
+            mem = _prof().memory_census()
+            attrs = dict(
+                live_bytes=mem["live_bytes"],
+                live_arrays=mem["live_arrays"],
+                peak_live_bytes=mem["peak_live_bytes"],
+                delta_bytes=mem["last_delta_bytes"],
+            )
+            if sp is not None:
+                spans_mod.update_attrs(sp, **attrs)
+        if payload is not None:
+            with spans_mod.span(
+                "lazy_deferred_check",
+                producing_span=(sp.span_id if sp is not None else 0),
+            ):
+                _timed_block(results, "deferred_naninf")
+                _nan_check(*payload, deferred=True, producing=sp)
+
+
+def sync():
+    """Synchronization barrier for the async runtime: dispatch everything
+    pending, surface any deferred NaN/Inf trip, and block (attributed) until
+    the device finished the last dispatched step. With ``FLAGS_lazy_async=0``
+    every flush already behaves like this."""
+    flush()
+    _drain_deferred()
+    inflight = getattr(_state, "inflight", None)
+    if inflight:
+        _state.inflight = None
+        _timed_block(inflight, "sync")
+
+
+# -- background compilation ---------------------------------------------------
+class _BgCompile:
+    """One background compile of a flush signature: ``jax.jit(replay)
+    .lower(*leaves).compile()`` on a daemon worker thread while the training
+    loop keeps stepping through the un-jitted replay. Lowering from the live
+    leaves (not synthetic avals) captures exact shapes/dtypes/weak-types; the
+    thread's reference to them dies with the compile."""
+
+    __slots__ = ("ready", "value", "error", "_thread")
+
+    def __init__(self, replay, donate_ix, leaves):
+        self.ready = False
+        self.value = None
+        self.error = None
+
+        def work(leaves=leaves):
+            try:
+                jf = (
+                    jax.jit(replay, donate_argnums=donate_ix)
+                    if donate_ix
+                    else jax.jit(replay)
+                )
+                self.value = jf.lower(*leaves).compile()
+            except Exception as e:  # surfaced as a sync-compile fallback
+                self.error = e
+            finally:
+                self.ready = True  # publish AFTER value/error (GIL ordering)
+
+        self._thread = threading.Thread(
+            target=work, daemon=True, name="lazy-bg-compile"
+        )
+        self._thread.start()
 
 
 def flush():
     """Execute all pending nodes as one jitted XLA computation and write the
-    results back into the live LazyArrays."""
+    results back into the live LazyArrays. With ``FLAGS_lazy_async`` (default)
+    the host returns as soon as the executable is dispatched — the results in
+    ``LazyArray._concrete`` are unblocked futures."""
+    if getattr(_state, "flushing", False):
+        return
+    # deferred work from the PREVIOUS flush surfaces before new work is
+    # dispatched — a deferred NaN trip is ≤1 step late, never dropped
+    _drain_deferred()
     g = getattr(_state, "graph", None)
     if g is None or not g.nodes:
         return
-    if getattr(_state, "flushing", False):
-        return
     _state.flushing = True
     try:
+        _state.graph = None  # fresh epoch for anything recorded during flush
+        # the sync() handle on the previous step's results must die BEFORE
+        # the donation mask runs — a held results list would inflate the
+        # refcount of every rebound buffer and defeat in-place updates
+        _state.inflight = None
         with _spans().span("lazy_flush", nodes=len(g.nodes)) as sp:
             _flush_impl(g, sp)
     finally:
@@ -523,48 +799,15 @@ def flush():
 
 def _flush_impl(g: _Graph, sp=None):
     nodes = g.nodes
-    g.nodes = []
-    node_index = {id(n): i for i, n in enumerate(nodes)}
+    leaves = g.leaves
+    descs_all = g.descs
 
-    leaves: list = []
-    leaf_pos: dict = {}
-    direct_uses: dict = {}  # id(leaf) -> occurrences in node input lists
-    via_lazy: set = set()  # leaf ids reached through a LazyArray._concrete
-    descs_all: list = []
-    sig_parts: list = []
+    # The wiring/signature was built incrementally by record(); the only
+    # flush-time trace work left is the output-liveness sweep.
     with _spans().span("trace", nodes=len(nodes)) as trace_span:
-        for n in nodes:
-            descs = []
-            for x in n.inputs:
-                indirect = False
-                if isinstance(x, LazyArray):
-                    if x._concrete is not None:
-                        x = x._concrete
-                        indirect = True
-                    else:
-                        i = node_index.get(id(x._node))
-                        if i is None:
-                            raise RuntimeError(
-                                "lazy graph invariant violated: input from a "
-                                "flushed-but-unmaterialized node"
-                            )
-                        descs.append(("n", i, x._idx))
-                        continue
-                j = leaf_pos.get(id(x))
-                if j is None:
-                    j = len(leaves)
-                    leaf_pos[id(x)] = j
-                    leaves.append(x)
-                if indirect:
-                    via_lazy.add(id(x))
-                else:
-                    direct_uses[id(x)] = direct_uses.get(id(x), 0) + 1
-                descs.append(("l", j))
-            descs_all.append(tuple(descs))
-            alive = tuple(r() is not None for r in n.out_refs)
-            sig_parts.append((n.key, tuple(descs), alive))
-        # drop loop bindings: they'd count as refs in the donation mask pass
-        x = n = None
+        alive_parts = tuple(
+            tuple(r() is not None for r in n.out_refs) for n in nodes
+        )
         trace_span.set(leaves=len(leaves))
 
     # Liveness pass: donate leaves that were rebound through this graph and
@@ -574,9 +817,10 @@ def _flush_impl(g: _Graph, sp=None):
     # FLAGS_check_nan_inf is set: a donated buffer is destroyed by the flush,
     # and on a NaN trip the pre-step state must survive for inspection (and
     # for the per-op unfused replay).
-    from ..framework import flags as _flags
+    _flags = _flags_mod()
 
     check_nan = bool(_flags.flag("FLAGS_check_nan_inf", False))
+    async_on = bool(_flags.flag("FLAGS_lazy_async", True))
     donate_ix: tuple = ()
     cand = getattr(_state, "donate_ids", None)
     if cand and _flags.flag("FLAGS_lazy_donate", True):
@@ -588,13 +832,13 @@ def _flush_impl(g: _Graph, sp=None):
                 sp.set(donation="suppressed_naninf")
         else:
             with _spans().span("donate", candidates=len(cand)) as dsp:
-                donate_ix = _donation_mask(leaves, cand, direct_uses, via_lazy)
+                donate_ix = _donation_mask(leaves, cand, g.direct_uses)
                 dsp.set(donated=len(donate_ix))
     if cand:
         cand.clear()
 
     try:
-        sig = (tuple(sig_parts), donate_ix)
+        sig = (tuple(g.keyparts), alive_parts, tuple(g.leaf_avals), donate_ix)
         hash(sig)
     except TypeError:
         sig = None
@@ -627,12 +871,25 @@ def _flush_impl(g: _Graph, sp=None):
             env = _interp(fns, wiring, leaf_vals)
             return [env[i][j] for (i, j) in live]
 
-        jitted = (
-            jax.jit(replay, donate_argnums=donate_ix) if donate_ix else jax.jit(replay)
-        )
-        # list, not tuple: the donation-error fallback swaps in a
-        # non-donating executable under the same signature
-        entry = [jitted, live, replay, donate_ix]
+        if (
+            async_on
+            and sig is not None
+            and _flags.flag("FLAGS_lazy_bg_compile", False)
+        ):
+            # compile off-thread; THIS step (and any same-signature step
+            # until the compile lands) completes via the un-jitted replay
+            task = _BgCompile(replay, donate_ix, list(leaves))
+            entry = [None, live, replay, donate_ix, task]
+            prof.counter_inc("lazy_bg_compiles")
+        else:
+            jitted = (
+                jax.jit(replay, donate_argnums=donate_ix)
+                if donate_ix
+                else jax.jit(replay)
+            )
+            # list, not tuple: the donation-error fallback swaps in a
+            # non-donating executable under the same signature
+            entry = [jitted, live, replay, donate_ix, None]
         if sig is not None:
             _flush_cache[sig] = entry
             if len(_flush_cache) > _FLUSH_CACHE_MAX:
@@ -641,96 +898,203 @@ def _flush_impl(g: _Graph, sp=None):
         _flush_cache.move_to_end(sig)
         prof.counter_inc("lazy_cache_hits")
 
-    jitted, live, replay, don = entry
+    jitted, live, replay, don, task = entry
     if sp is not None and don:
         sp.set(
             donated_buffers=len(don),
             donated_bytes=sum(int(getattr(leaves[j], "nbytes", 0)) for j in don),
         )
-    try:
-        if don:
-            _ignore_donation_warnings()
-        # a miss pays trace+compile inside this first invocation; a hit is a
-        # pure executable replay — the span name is the attribution
-        with _spans().span(
-            "execute" if cache_hit else "compile", cache="hit" if cache_hit else "miss"
-        ):
-            results = jitted(*leaves)
-        if don:
-            prof.counter_inc("lazy_donated_buffers", len(don))
-    except Exception:
-        donated_dead = any(
-            getattr(l, "is_deleted", _false)()
-            for l in leaves
-            if isinstance(l, jax.Array)
-        )
-        if don and not donated_dead:
-            # XLA rejected the donation (or the donating executable failed
-            # before invalidating inputs): permanently fall back to a
-            # non-donating executable under this signature
-            prof.counter_inc("lazy_donation_fallbacks")
-            if sp is not None:
-                sp.set(fallback="donation_rejected")
-            jitted = jax.jit(replay)
-            entry[0] = jitted
-            entry[3] = ()
-            try:
-                with _spans().span("compile", cache="miss", fallback="donation_rejected"):
-                    results = jitted(*leaves)
-            except Exception:
+    if jitted is None and task is not None:
+        # background compile in flight: pick it up if finished, else keep
+        # stepping through the replay fallback
+        if task.ready:
+            if task.error is None:
+                jitted = entry[0] = task.value
+                entry[4] = None
+                prof.counter_inc("lazy_bg_pickups")
                 if sp is not None:
-                    sp.set(fallback="eager_replay")
-                with _spans().span("execute", fallback="eager_replay"):
-                    results = replay(*[jnp.asarray(v) for v in leaves])
-        elif donated_dead:
-            # inputs were invalidated mid-execution; eager replay impossible
-            raise
-        else:
-            # fallback: run un-jitted (still one pass, concrete ops)
-            if sp is not None:
-                sp.set(fallback="eager_replay")
-            with _spans().span("execute", fallback="eager_replay"):
-                results = replay(*[jnp.asarray(v) for v in leaves])
+                    sp.set(bg_compile="picked_up")
+            else:
+                # bg compile failed — compile synchronously under this
+                # signature; a persistent error then surfaces on execution
+                jitted = entry[0] = (
+                    jax.jit(replay, donate_argnums=don) if don else jax.jit(replay)
+                )
+                entry[4] = None
+                prof.counter_inc("lazy_bg_compile_failures")
+                if sp is not None:
+                    sp.set(bg_compile="failed", bg_error=type(task.error).__name__)
+    # a bg-compile pickup leaves an AOT Compiled in entry[0]; unlike jax.jit
+    # it cannot re-trace, so execution failures get an extra fallback rung
+    aot = jitted is not None and not hasattr(jitted, "lower")
+
+    results = None
+    if jitted is None:
+        # replay-while-compiling: one eager pass, correct but unfused
+        prof.counter_inc("lazy_bg_replays")
+        if sp is not None:
+            sp.set(bg_compile="pending")
+        with _spans().span("execute", cache="miss", fallback="bg_compiling"):
+            results = replay(*leaves)
+    else:
+        try:
+            if don:
+                _ignore_donation_warnings()
+            # a miss pays trace+compile inside this first invocation; a hit
+            # is a pure executable launch — with the async runtime the host
+            # RETURNS at dispatch ("dispatch" span), only the sync kill-switch
+            # path keeps the old "execute" attribution
+            span_name = (
+                "compile"
+                if not cache_hit
+                else ("dispatch" if async_on else "execute")
+            )
+            with _spans().span(
+                span_name, cache="hit" if cache_hit else "miss"
+            ):
+                results = jitted(*leaves)
+            if don:
+                prof.counter_inc("lazy_donated_buffers", len(don))
+        except Exception:
+            donated_dead = any(
+                getattr(l, "is_deleted", _false)()
+                for l in leaves
+                if isinstance(l, jax.Array)
+            )
+            if aot and not donated_dead:
+                # AOT executables (bg-compile pickups) don't re-trace on an
+                # input-aval drift the way jax.jit does — swap in the
+                # polymorphic jit under the same signature and retry
+                prof.counter_inc("lazy_bg_aot_fallbacks")
+                if sp is not None:
+                    sp.set(fallback="aot_retrace")
+                jitted = entry[0] = (
+                    jax.jit(replay, donate_argnums=don) if don else jax.jit(replay)
+                )
+                try:
+                    with _spans().span("compile", cache="miss", fallback="aot_retrace"):
+                        results = jitted(*leaves)
+                    if don:
+                        prof.counter_inc("lazy_donated_buffers", len(don))
+                except Exception:
+                    results = _fallback_execute(
+                        entry, leaves, replay, don, donated_dead, sp, prof
+                    )
+            else:
+                results = _fallback_execute(
+                    entry, leaves, replay, don, donated_dead, sp, prof
+                )
 
     for (i, j), val in zip(live, results):
         o = nodes[i].out_refs[j]()
         if o is not None:
             o._concrete = val
+    _state.inflight = results  # sync() blocks on the last dispatched step
 
-    # Memory accounting (profiler profile_memory / FLAGS_profile_memory):
-    # live-buffer census at the flush boundary — the point where donated
-    # inputs are gone and outputs exist, so the delta IS the step's real
-    # memory effect and the peak gauge tracks the high-water mark.
-    if prof._memory_active():
-        mem = prof.memory_census()
-        if sp is not None:
-            sp.set(
-                live_bytes=mem["live_bytes"],
-                live_arrays=mem["live_arrays"],
-                peak_live_bytes=mem["peak_live_bytes"],
-                delta_bytes=mem["last_delta_bytes"],
+    mem_active = prof._memory_active()
+    if async_on and (check_nan or mem_active):
+        # post-flush scans move OFF the critical path: enqueued against the
+        # dispatched arrays, they run at the next flush / materialization /
+        # sync() — the host returns now, overlapping step k+1's trace with
+        # step k's device execution
+        payload = None
+        if check_nan:
+            payload = (
+                [n2.key[0] for n2 in nodes],
+                [n2.fn for n2 in nodes],
+                live,
+                results,
+                leaves,
+                descs_all,
+            )
+            prof.counter_inc("lazy_deferred_checks")
+        _enqueue_deferred(sp, payload, mem_active, results)
+    else:
+        # Memory accounting (profiler profile_memory / FLAGS_profile_memory):
+        # live-buffer census at the flush boundary — the point where donated
+        # inputs are gone and outputs exist, so the delta IS the step's real
+        # memory effect and the peak gauge tracks the high-water mark.
+        if mem_active:
+            mem = prof.memory_census()
+            if sp is not None:
+                sp.set(
+                    live_bytes=mem["live_bytes"],
+                    live_arrays=mem["live_arrays"],
+                    peak_live_bytes=mem["peak_live_bytes"],
+                    delta_bytes=mem["last_delta_bytes"],
+                )
+        # FLAGS_check_nan_inf with the async runtime OFF: scan the flush
+        # outputs synchronously AFTER the writeback (the materialized state
+        # stays inspectable — donation was suppressed above, so pre-step
+        # buffers survive too) and raise within the same step.
+        if check_nan:
+            _nan_check(
+                [n2.key[0] for n2 in nodes],
+                [n2.fn for n2 in nodes],
+                live, results, leaves, descs_all,
             )
 
-    # FLAGS_check_nan_inf under the lazy engine: scan the flush outputs AFTER
-    # the writeback (the materialized state stays inspectable — donation was
-    # suppressed above, so pre-step buffers survive too) and raise within the
-    # same step the NaN was produced.
-    if check_nan:
-        _postflush_nan_check(nodes, live, results, leaves, descs_all)
+    # Release the graph's buffer references: without this, a live LazyArray
+    # output (e.g. a held loss) would pin every input buffer of its whole
+    # step through node.inputs until the handle died.
+    for n2 in nodes:
+        n2.inputs = ()
+        n2.graph = None
 
 
-def _postflush_nan_check(nodes, live, results, leaves, descs_all):
+def _fallback_execute(entry, leaves, replay, don, donated_dead, sp, prof):
+    """Donation-rejection / eager fallbacks shared by the jit and AOT paths
+    (semantics unchanged from the synchronous runtime)."""
+    if don and not donated_dead:
+        # XLA rejected the donation (or the donating executable failed
+        # before invalidating inputs): permanently fall back to a
+        # non-donating executable under this signature
+        prof.counter_inc("lazy_donation_fallbacks")
+        if sp is not None:
+            sp.set(fallback="donation_rejected")
+        jitted = jax.jit(replay)
+        entry[0] = jitted
+        entry[3] = ()
+        try:
+            with _spans().span("compile", cache="miss", fallback="donation_rejected"):
+                return jitted(*leaves)
+        except Exception:
+            if sp is not None:
+                sp.set(fallback="eager_replay")
+            with _spans().span("execute", fallback="eager_replay"):
+                return replay(*[jnp.asarray(v) for v in leaves])
+    elif donated_dead:
+        # inputs were invalidated mid-execution; eager replay impossible
+        raise
+    else:
+        # fallback: run un-jitted (still one pass, concrete ops)
+        if sp is not None:
+            sp.set(fallback="eager_replay")
+        with _spans().span("execute", fallback="eager_replay"):
+            return replay(*[jnp.asarray(v) for v in leaves])
+
+
+def _nan_check(keys, fns, live, results, leaves, descs_all,
+               deferred=False, producing=None):
     """Post-flush nan/inf scan (reference operator.cc:1171 semantics adapted
     to fused execution). Default mode scans the LIVE flush outputs — a NaN
     in an intermediate that was fused away AND masked out of every live
     output is invisible (the price of keeping fusion). Opt-in
     FLAGS_check_nan_inf_per_op re-runs the graph UNFUSED on every flush and
     checks EVERY node output — full reference parity (dead intermediates
-    included) at the reference's documented debug cost (~2x compute)."""
-    from ..framework import flags as _flags
+    included) at the reference's documented debug cost (~2x compute).
+
+    In deferred mode (async runtime) the same scan runs against the retained
+    arrays at the NEXT flush/materialization/sync; ``producing`` is the
+    closed ``lazy_flush`` span of the step that built these values, threaded
+    into the flight-recorder dump so the post-mortem still names it."""
     from .dispatch import _nonfinite_error, _prof
 
-    if _flags.flag("FLAGS_check_nan_inf_per_op", False):
+    origin_sfx = " (deferred)" if deferred else ""
+    extra = None
+    if producing is not None:
+        extra = {"producing_span": producing.to_dict()}
+    if _flags_mod().flag("FLAGS_check_nan_inf_per_op", False):
         # Unfused replay: same wiring, eager ops, every node output checked,
         # first offender attributed to its producing op.
         def check_node(i2, outs):
@@ -739,17 +1103,20 @@ def _postflush_nan_check(nodes, live, results, leaves, descs_all):
                     if not bool(jnp.isfinite(out).all()):
                         _prof().counter_inc("naninf_trips")
                         raise _nonfinite_error(
-                            nodes[i2].key[0], j2, out, origin="lazy per-op replay"
+                            keys[i2], j2, out,
+                            origin="lazy per-op replay" + origin_sfx,
+                            extra=extra,
                         )
 
-        _interp([n2.fn for n2 in nodes], descs_all, leaves, on_node=check_node)
+        _interp(fns, descs_all, leaves, on_node=check_node)
         return
     for (i, j), val in zip(live, results):
         if hasattr(val, "dtype") and jnp.issubdtype(val.dtype, jnp.floating):
             if not bool(jnp.isfinite(val).all()):
                 _prof().counter_inc("naninf_trips")
                 raise _nonfinite_error(
-                    nodes[i].key[0], j, val, origin="lazy flush", hint=True
+                    keys[i], j, val, origin="lazy flush" + origin_sfx,
+                    hint=True, extra=extra,
                 )
 
 

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from . import dtype as dtypes
 from . import place as place_mod
 from .engine import run_backward, no_grad
-from .lazy import LazyArray, note_rebound
+from .lazy import LazyArray, note_rebound, timed_block as lazy_timed_block
 
 _tensor_count = 0
 
@@ -103,7 +103,12 @@ class Tensor:
 
     # -- host interop -----------------------------------------------------
     def numpy(self):
-        return np.asarray(self._data)
+        d = self._data
+        if isinstance(d, LazyArray):
+            d = d._value()
+        # attributed host wait (async runtime): the time spent here waiting
+        # for the device is the dispatch gap, not an anonymous np.asarray
+        return np.asarray(lazy_timed_block(d))
 
     def item(self, *args):
         if args:

@@ -270,6 +270,21 @@ class HybridParallelEngine:
 
         return step_fn
 
+    def prefetch(self, data, buffer_size=2):
+        """Wrap a DataLoader (or any batch iterable) in a device-side
+        double-buffer committed to THIS engine's batch shardings: batch k+1
+        is transferred (and GSPMD-placed) by a background thread while step k
+        executes, so ``_prepare``'s per-step ``device_put`` degenerates to a
+        no-op (async runtime tentpole; reference buffered_reader.cc)."""
+        from ..io import DevicePrefetcher
+
+        self.place()
+
+        def sharding_of(i, arr):
+            return self._batch_sharding(i if i is not None else 0, arr)
+
+        return DevicePrefetcher(data, buffer_size=buffer_size, sharding=sharding_of)
+
     def _prepare(self, *batch):
         self.place()
         if self._jit is None:

@@ -34,6 +34,21 @@ _FLAGS: Dict[str, object] = {
     # XLA updates weights in place instead of copying ~3x model size per
     # step. FLAGS_lazy_donate=0 is the kill-switch.
     "FLAGS_lazy_donate": True,
+    # Async lazy runtime (arXiv:2102.13267 overlap): the flush returns at
+    # executable DISPATCH (results are unblocked jax.Array futures), the
+    # NaN/Inf guard scan and the telemetry memory census run off the critical
+    # path (deferred to the next flush/materialization/lazy.sync(), trip
+    # surfaces ≤1 step late), and host readback waits are attributed via
+    # `block` spans + lazy_block_ns. FLAGS_lazy_async=0 is the kill-switch
+    # restoring the fully synchronous behavior.
+    "FLAGS_lazy_async": True,
+    # Background compilation of flush-cache misses: the miss step (and any
+    # same-signature step until the compile lands) executes via the un-jitted
+    # replay while a worker thread compiles the fused executable. OPT-IN:
+    # the unfused replay can differ from the fused executable by ~1 ulp and
+    # the pickup step depends on compile latency, so loops that pin bitwise
+    # reproducibility across runs must leave it off. Needs FLAGS_lazy_async.
+    "FLAGS_lazy_bg_compile": False,
     # ZeRO-1 sharded weight update for pure-DP meshes (arXiv:2004.13336):
     # reduce_scatter(grads) -> each replica updates its 1/dp shard of params
     # + optimizer moments -> all_gather(params), with grads coalesced into

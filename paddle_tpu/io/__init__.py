@@ -13,6 +13,7 @@ import itertools
 import math
 import queue as _queue
 import threading
+import weakref
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -518,6 +519,162 @@ class _IterableIter:
         return self.collate_fn(batch)
 
 
+class DevicePrefetcher:
+    """Device-side input double-buffering (async runtime tentpole; reference
+    ``operators/reader/buffered_reader.cc`` async device prefetch).
+
+    The host-side pipeline above stages batches in HOST memory; the step
+    still paid the host→device transfer synchronously when it consumed one.
+    This stage closes that gap: a daemon thread pulls batches from ``it``,
+    issues ``jax.device_put`` for every array leaf — committed to
+    ``sharding(i, arr)`` when the training engine provides one — and keeps up
+    to ``buffer_size`` device-resident batches staged, so batch k+1's
+    transfer overlaps step k's execution (PJRT H2D is async; the thread also
+    hides the host-side copy/conversion cost).
+
+    ``sharding`` is ``None`` (default device placement), a fixed jax sharding
+    applied to every leaf, or a callable ``(leaf_index, array) -> sharding``
+    (what ``HybridParallelEngine.prefetch`` passes so batches land already
+    committed to the step's GSPMD layout — the engine's own ``device_put``
+    then becomes a no-op).
+
+    Ordering is preserved; a worker exception is re-raised at the consumer's
+    ``next()``; ``close()`` (also called on exhaustion and by ``__del__``)
+    tears the thread down without draining the source.
+    """
+
+    _DONE = object()
+
+    def __init__(self, it, buffer_size=2, sharding=None):
+        import jax
+
+        self._jax = jax
+        self._it = iter(it)
+        self._sharding = sharding
+        self._q: _queue.Queue = _queue.Queue(maxsize=max(1, int(buffer_size)))
+        self._stop = threading.Event()
+        # The worker must NOT hold a strong ref to self (a bound-method
+        # target would): an abandoned prefetcher (early `break`) could then
+        # never be collected, so __del__->close() would never fire and the
+        # thread would spin in the put-retry loop forever. It gets a weakref
+        # plus its own refs to the queue/stop/iterator instead.
+        self._thread = threading.Thread(
+            target=DevicePrefetcher._loop,
+            args=(weakref.ref(self), self._it, self._q, self._stop),
+            daemon=True,
+            name="device-prefetch",
+        )
+        self._thread.start()
+
+    # -- producer ----------------------------------------------------------
+    def _place(self, i, arr):
+        sh = self._sharding(i, arr) if callable(self._sharding) else self._sharding
+        return (
+            self._jax.device_put(arr, sh)
+            if sh is not None
+            else self._jax.device_put(arr)
+        )
+
+    def _transfer(self, obj, i=None):
+        """Move every array leaf to device. ``i`` is the top-level position
+        (the engine's per-input sharding index); nested leaves inherit it."""
+        if isinstance(obj, Tensor):
+            d = obj._data
+            from ..core import lazy as lazy_mod
+
+            t = Tensor(self._place(i, lazy_mod.concrete(d)), stop_gradient=obj.stop_gradient)
+            return t
+        if isinstance(obj, np.ndarray):
+            return Tensor(self._place(i, obj))
+        if isinstance(obj, (list, tuple)):
+            staged = [
+                self._transfer(o, idx if i is None else i)
+                for idx, o in enumerate(obj)
+            ]
+            # namedtuples (custom collate_fns return them) need star-args
+            if hasattr(obj, "_fields"):
+                return type(obj)(*staged)
+            return type(obj)(staged)
+        if isinstance(obj, dict):
+            return {k: self._transfer(v, i) for k, v in obj.items()}
+        return obj
+
+    @staticmethod
+    def _loop(wref, it, q, stop):
+        from .. import profiler
+
+        while not stop.is_set():
+            try:
+                batch = next(it)
+            except StopIteration:
+                q.put((DevicePrefetcher._DONE, None))
+                return
+            except Exception as e:
+                q.put(("err", e))
+                return
+            owner = wref()
+            if owner is None:
+                return
+            try:
+                staged = owner._transfer(batch)
+                profiler.counter_inc("io_device_prefetched")
+            except Exception as e:
+                q.put(("err", e))
+                return
+            finally:
+                del owner  # don't pin the prefetcher while blocked below
+            # bounded staging: blocks while `buffer_size` batches are already
+            # device-resident, with a timeout so close() (or the owner being
+            # garbage-collected) can interrupt
+            while not stop.is_set():
+                try:
+                    q.put(("ok", staged), timeout=0.1)
+                    break
+                except _queue.Full:
+                    if wref() is None:
+                        return
+
+    # -- consumer ----------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        kind, payload = self._q.get()
+        if kind is self._DONE:
+            self.close()
+            raise StopIteration
+        if kind == "err":
+            self.close()
+            raise payload
+        return payload
+
+    def close(self):
+        """Stop the prefetch thread (idempotent). Staged batches are
+        discarded; the underlying iterator is NOT drained."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()  # unblock a producer stuck on put()
+        except _queue.Empty:
+            pass
+        t = getattr(self, "_thread", None)
+        if t is not None and t.is_alive() and t is not threading.current_thread():
+            t.join(timeout=2.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def device_prefetch(it, buffer_size=2, sharding=None):
+    """Functional wrapper: ``for batch in device_prefetch(loader): ...``"""
+    return DevicePrefetcher(it, buffer_size=buffer_size, sharding=sharding)
+
+
 class DataLoader:
     def __init__(
         self,
@@ -538,6 +695,7 @@ class DataLoader:
         worker_init_fn=None,
         persistent_workers=False,
         use_multiprocess=None,
+        device_prefetch=0,
     ):
         self.dataset = dataset
         self.return_list = return_list
@@ -545,6 +703,10 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
+        # device-side double-buffering: N batches staged ON DEVICE ahead of
+        # the consumer (0 = off). Training engines wrap the loader with a
+        # sharding-aware DevicePrefetcher instead (engine.prefetch()).
+        self.device_prefetch = int(device_prefetch or 0)
         # worker PROCESSES (reference default: GIL-free preprocessing via
         # dataloader_iter.py:326 fork+shared-memory); False → thread workers.
         # use_multiprocess overrides explicitly; otherwise follow
@@ -566,13 +728,19 @@ class DataLoader:
 
     def __iter__(self):
         if isinstance(self.dataset, IterableDataset):
-            return _IterableIter(self)
-        if self.num_workers > 0 and self.use_multiprocess:
+            it = _IterableIter(self)
+        elif self.num_workers > 0 and self.use_multiprocess:
             import multiprocessing as mp
 
             if "fork" in mp.get_all_start_methods():
-                return _MultiprocessIter(self)
-        return _DataLoaderIter(self)
+                it = _MultiprocessIter(self)
+            else:
+                it = _DataLoaderIter(self)
+        else:
+            it = _DataLoaderIter(self)
+        if self.device_prefetch > 0:
+            return DevicePrefetcher(it, buffer_size=self.device_prefetch)
+        return it
 
     def __len__(self):
         if self.batch_sampler is None:

@@ -1,10 +1,30 @@
-"""paddle.metric parity (reference python/paddle/metric/metrics.py)."""
+"""paddle.metric parity (reference python/paddle/metric/metrics.py).
+
+Readback discipline (async runtime): every ``update()`` coalesces its device
+reads into ONE host sync via :func:`_host` — the old per-tensor
+``np.asarray`` pattern forced 2+ blocking device→host readbacks per batch,
+each of which also split the lazy engine's fused step. Accumulators stay on
+host (plain floats/ints/np arrays), so ``accumulate()`` never touches the
+device.
+"""
 from __future__ import annotations
 
 import numpy as np
+import jax
 
 from ..core.tensor import Tensor
 from ..core.dispatch import as_tensor
+from ..core import lazy as _lazy
+
+
+def _host(*xs):
+    """Materialize every argument with a single device sync: one lazy flush
+    (the first ``concrete`` call dispatches the whole pending graph), one
+    attributed wait, one batched ``jax.device_get`` transfer — instead of
+    one blocking ``np.asarray`` per tensor."""
+    arrs = [_lazy.concrete(as_tensor(x)._data) for x in xs]
+    _lazy.timed_block(arrs, "metric_update")
+    return [np.asarray(a) for a in jax.device_get(arrs)]
 
 
 class Metric:
@@ -39,8 +59,7 @@ class Accuracy(Metric):
         self.count = [0] * len(self.topk)
 
     def compute(self, pred, label, *args):
-        pred = np.asarray(as_tensor(pred)._data)
-        label = np.asarray(as_tensor(label)._data)
+        pred, label = _host(pred, label)  # one sync, not two
         if label.ndim == 1:
             label = label.reshape(-1, 1)
         maxk = max(self.topk)
@@ -49,7 +68,7 @@ class Accuracy(Metric):
         return Tensor(correct.astype(np.float32))
 
     def update(self, correct, *args):
-        correct = np.asarray(as_tensor(correct)._data)
+        (correct,) = _host(correct)
         accs = []
         for i, k in enumerate(self.topk):
             num = correct[..., :k].sum()
@@ -77,8 +96,9 @@ class Precision(Metric):
         self.fp = 0
 
     def update(self, preds, labels):
-        preds = np.asarray(as_tensor(preds)._data).round().astype(np.int32).reshape(-1)
-        labels = np.asarray(as_tensor(labels)._data).astype(np.int32).reshape(-1)
+        preds, labels = _host(preds, labels)
+        preds = preds.round().astype(np.int32).reshape(-1)
+        labels = labels.astype(np.int32).reshape(-1)
         self.tp += int(((preds == 1) & (labels == 1)).sum())
         self.fp += int(((preds == 1) & (labels == 0)).sum())
 
@@ -100,8 +120,9 @@ class Recall(Metric):
         self.fn = 0
 
     def update(self, preds, labels):
-        preds = np.asarray(as_tensor(preds)._data).round().astype(np.int32).reshape(-1)
-        labels = np.asarray(as_tensor(labels)._data).astype(np.int32).reshape(-1)
+        preds, labels = _host(preds, labels)
+        preds = preds.round().astype(np.int32).reshape(-1)
+        labels = labels.astype(np.int32).reshape(-1)
         self.tp += int(((preds == 1) & (labels == 1)).sum())
         self.fn += int(((preds == 0) & (labels == 1)).sum())
 
@@ -124,17 +145,15 @@ class Auc(Metric):
         self._stat_neg = np.zeros(self.num_thresholds + 1)
 
     def update(self, preds, labels):
-        preds = np.asarray(as_tensor(preds)._data)
-        labels = np.asarray(as_tensor(labels)._data).reshape(-1)
+        preds, labels = _host(preds, labels)
+        labels = labels.reshape(-1)
         if preds.ndim == 2:
             preds = preds[:, 1]
         preds = preds.reshape(-1)
         bins = np.minimum((preds * self.num_thresholds).astype(np.int64), self.num_thresholds)
-        for b, l in zip(bins, labels):
-            if l:
-                self._stat_pos[b] += 1
-            else:
-                self._stat_neg[b] += 1
+        pos = labels.astype(bool)
+        np.add.at(self._stat_pos, bins[pos], 1)
+        np.add.at(self._stat_neg, bins[~pos], 1)
 
     def accumulate(self):
         tot_pos = self._stat_pos.sum()
@@ -153,8 +172,8 @@ class Auc(Metric):
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):
-    pred = np.asarray(as_tensor(input)._data)
-    lab = np.asarray(as_tensor(label)._data).reshape(-1)
+    pred, lab = _host(input, label)
+    lab = lab.reshape(-1)
     idx = np.argsort(-pred, axis=-1)[..., :k]
     hit = (idx == lab[:, None]).any(axis=-1)
     return Tensor(np.asarray(hit.mean(), dtype=np.float32))

@@ -243,7 +243,19 @@ class ReduceOnPlateau(LRScheduler):
     def step(self, metrics=None, epoch=None):
         if metrics is None:
             return
-        current = float(metrics.item() if hasattr(metrics, "item") else metrics)
+        if isinstance(metrics, (int, float)):
+            # a Python number needs no device readback at all
+            current = float(metrics)
+        else:
+            # Tensor/array metric: dispatch any pending lazy work FIRST so
+            # the readback below waits only on the device — an unconditional
+            # mid-step .item() used to force a synchronous flush+sync even
+            # in lazy mode (async-runtime satellite). The wait is attributed
+            # (block span / lazy_block_ns) through Tensor.numpy().
+            from ..core import lazy as _lazy
+
+            _lazy.flush()
+            current = float(metrics.item() if hasattr(metrics, "item") else metrics)
         if self.best is None:
             self.best = current
             return

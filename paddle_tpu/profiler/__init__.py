@@ -81,6 +81,17 @@ def counters() -> Dict[str, int]:
     ``dispatch_fastkey_hits`` is per-op and only counted while the profiler
     is running, to keep the dispatch hot path free of bookkeeping.
 
+    Async runtime (FLAGS_lazy_async): ``lazy_blocks`` / ``lazy_block_ns``
+    (attributed host waits on the device — the dispatch-gap metric bench.py
+    reports per step), ``lazy_deferred_checks`` (NaN/Inf scans moved off the
+    critical path), ``lazy_bg_compiles`` / ``lazy_bg_replays`` /
+    ``lazy_bg_pickups`` / ``lazy_bg_compile_failures`` /
+    ``lazy_bg_aot_fallbacks`` (FLAGS_lazy_bg_compile background compilation:
+    misses compiling off-thread, steps served by the un-jitted replay
+    meanwhile, compiled executables picked up, and fallbacks), and
+    ``io_device_prefetched`` (batches staged on device by the
+    DevicePrefetcher input stage).
+
     Fault tolerance: ``ckpt_saves`` / ``ckpt_save_failures`` /
     ``ckpt_resume_fallbacks`` (crash-safe checkpointing),
     ``preemption_drains`` (PreemptionGuard SIGTERM drains),

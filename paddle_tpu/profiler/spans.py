@@ -177,6 +177,27 @@ def _emit(sp: Span) -> None:
         _span_events.append(sp)  # Span carries its own attrs to export
 
 
+def update_attrs(sp: Span, **attrs) -> None:
+    """Attach attributes to an ALREADY-FINISHED span (async runtime: the
+    deferred memory census lands on the producing ``lazy_flush`` span after
+    it closed). Python sinks (session list, flight ring) hold the Span object
+    itself, so mutating it is enough; when the span's timing record went to
+    the native ring, the side-table copy is refreshed too."""
+    sp.attrs.update(attrs)
+    if _pkg is not None and sp.span_id in _span_attrs:
+        _span_attrs[sp.span_id] = dict(sp.attrs)
+    elif (
+        _pkg is not None
+        and _pkg._enabled
+        and _pkg._native_spans
+        and sp.attrs
+        and _pkg._native_recorder() is not None
+    ):
+        if len(_span_attrs) >= _SPAN_ATTRS_MAX:
+            _span_attrs.pop(next(iter(_span_attrs)))
+        _span_attrs[sp.span_id] = dict(sp.attrs)
+
+
 def _reset_session() -> None:
     _span_events.clear()
     _span_attrs.clear()

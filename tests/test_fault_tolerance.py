@@ -339,8 +339,13 @@ class TestFlightRecorderDumps:
         assert path is not None and path.startswith(str(tmp_path))
         doc = json.load(open(path))
         assert doc["reason"] == "naninf"
-        # the dumping thread was INSIDE the flush: the open-span stack names it
-        assert any(s["name"] == "lazy_flush" for s in doc["active_spans"])
+        # async runtime: the trip surfaces at the deferred drain, where the
+        # producing lazy_flush span (already closed) rides the dump's extra;
+        # with FLAGS_lazy_async=0 it would still be on the open-span stack
+        prod = doc["extra"].get("producing_span")
+        assert (
+            prod is not None and prod["name"] == "lazy_flush"
+        ) or any(s["name"] == "lazy_flush" for s in doc["active_spans"])
         assert len(doc["recent_spans"]) >= 32
         assert doc["counters"].get("naninf_trips", 0) >= 1
         assert doc["counters"].get("lazy_flushes", 0) >= 10

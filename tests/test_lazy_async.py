@@ -1,0 +1,403 @@
+"""Async lazy runtime (ISSUE 6) — non-blocking dispatch, deferred guards,
+background compilation, and device-side input prefetch.
+
+Pins:
+* bit-for-bit parity of a k-step Adam train loop with ``FLAGS_lazy_async``
+  on vs off (the async restructure must not change a single bit);
+* the deferred NaN/Inf guard still trips (≤1 step late, at the next
+  flush/materialization/sync), still writes a flight-recorder dump naming
+  the PRODUCING ``lazy_flush`` span, and still suppresses donation while
+  armed;
+* ``FLAGS_lazy_bg_compile``: a cache-miss step completes via the un-jitted
+  replay while the executable compiles off-thread, and a later step picks
+  the compiled executable up (counter asserts on both sides);
+* the device-prefetch input stage preserves ordering, propagates worker
+  errors, and shuts its thread down;
+* tier-1 tripwire: the ``FLAGS_lazy_async=0`` kill-switch restores the old
+  synchronous semantics exactly, and with async ON no blocking-readback
+  (``block``) span ever appears inside a ``lazy_flush`` span — a future
+  accidental ``.block_until_ready()``/``np.asarray`` on the hot path makes
+  this grep fail fast.
+"""
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import profiler
+from paddle_tpu.core import lazy
+from paddle_tpu.fault import inject
+from paddle_tpu.profiler import flight
+
+
+@pytest.fixture(autouse=True)
+def _clean_flags():
+    lazy.set_lazy_mode(True)
+    yield
+    inject.disarm()
+    paddle.set_flags({
+        "FLAGS_lazy_async": True,
+        "FLAGS_lazy_bg_compile": False,
+        "FLAGS_check_nan_inf": False,
+        "FLAGS_check_nan_inf_per_op": False,
+        "FLAGS_lazy_donate": True,
+    })
+    try:
+        lazy.sync()
+    except FloatingPointError:
+        pass
+    lazy.set_lazy_mode(True)
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def _train(async_on, steps=4):
+    paddle.set_flags({"FLAGS_lazy_async": bool(async_on)})
+    paddle.seed(7)
+    m = MLP()
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+    losses = []
+    for i in range(steps):
+        x = paddle.to_tensor(np.random.RandomState(i).randn(8, 16).astype("float32"))
+        y = paddle.to_tensor(np.random.RandomState(100 + i).randint(0, 10, (8,)))
+        loss = F.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    weights = [np.asarray(lazy.concrete(p._data)).copy() for p in m.parameters()]
+    paddle.set_flags({"FLAGS_lazy_async": True})
+    return losses, weights
+
+
+class TestAsyncParity:
+    def test_async_vs_sync_bit_for_bit(self):
+        """Acceptance: k-step Adam train loss (and final params) bit-for-bit
+        identical with FLAGS_lazy_async on vs off on CPU."""
+        on_l, on_w = _train(True, steps=4)
+        off_l, off_w = _train(False, steps=4)
+        assert on_l == off_l  # float equality — not allclose
+        for a, b in zip(on_w, off_w):
+            np.testing.assert_array_equal(a, b)
+
+    def test_sync_is_a_barrier(self):
+        t = paddle.to_tensor(np.ones(32, np.float32))
+        u = (t * 2.0 + 1.0)._data
+        assert lazy.is_lazy(u) and u._concrete is None
+        before = profiler.counters().get("lazy_blocks", 0)
+        lazy.sync()
+        assert u._concrete is not None and u._concrete.is_ready()
+        assert profiler.counters().get("lazy_blocks", 0) > before
+        np.testing.assert_array_equal(np.asarray(u._concrete), np.full(32, 3.0))
+
+    def test_flush_cache_still_stable_and_donating(self):
+        """The async restructure keeps PR-1 invariants: one executable per
+        iteration signature, steady-state in-place (donated) updates."""
+        profiler.reset_counters()
+        _train(True, steps=5)
+        c = profiler.counters()
+        assert c.get("lazy_cache_hits", 0) >= 3
+        assert c.get("lazy_donated_buffers", 0) > 0
+        assert c.get("lazy_donation_fallbacks", 0) == 0
+
+
+class TestDeferredNanGuard:
+    def test_trip_surfaces_at_next_flush_with_producing_span(self, tmp_path, monkeypatch):
+        """The deferred guard raises ≤1 step late and the flight dump still
+        names the producing lazy_flush span (ISSUE-6 acceptance)."""
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        w = paddle.to_tensor(np.zeros(4, np.float32))
+        bad = paddle.log(w - 1.0)  # NaN born lazily
+        lazy.flush()  # dispatches; the scan is deferred, NO raise here
+        assert profiler.counters().get("lazy_deferred_checks", 0) >= 1
+        ok = w + 1.0
+        with pytest.raises(FloatingPointError, match="log"):
+            lazy.flush()  # next flush drains the deferred check
+        doc = json.load(open(flight.last_dump()))
+        assert doc["reason"] == "naninf"
+        prod = doc["extra"]["producing_span"]
+        assert prod["name"] == "lazy_flush"
+        assert doc["extra"]["origin"] == "lazy flush (deferred)"
+
+    def test_trip_surfaces_at_sync(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        w = paddle.to_tensor(np.zeros(2, np.float32))
+        paddle.log(w - 1.0) * 2.0  # held by nothing: per-op path not needed
+        t = paddle.log(w - 1.0)
+        lazy.flush()
+        with pytest.raises(FloatingPointError):
+            lazy.sync()
+
+    def test_injected_nan_deferred_attribution(self, tmp_path, monkeypatch):
+        """fault/inject.py tensor.nan poisons INSIDE the fused step; the
+        deferred guard must still catch it with producing-span attribution
+        and per-op mode must still name the poisoned op."""
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        inject.arm({"tensor.nan": {"op": "matmul", "call": 1}})
+        a = paddle.to_tensor(np.ones((4, 4), np.float32))
+        b = paddle.to_tensor(np.ones((4, 4), np.float32))
+        c = paddle.matmul(a, b)
+        lazy.flush()  # poison dispatched, check deferred
+        with pytest.raises(FloatingPointError):
+            lazy.sync()
+        doc = json.load(open(flight.last_dump()))
+        assert doc["extra"]["producing_span"]["name"] == "lazy_flush"
+        assert doc["fault_inject"]["armed"] is True
+
+    def test_materialization_same_step_semantics_kept(self):
+        """A loop that materializes every step still sees the trip within
+        the step it reads — the drain runs at every materialization point."""
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        w = paddle.to_tensor(np.zeros(3, np.float32))
+        t = paddle.log(w - 1.0)
+        with pytest.raises(FloatingPointError, match="log"):
+            t.numpy()
+
+    def test_donation_still_suppressed_while_armed(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        before = profiler.counters().get("naninf_donation_suppressed", 0)
+        donated = profiler.counters().get("lazy_donated_buffers", 0)
+        w = paddle.to_tensor(np.ones(4, np.float32))
+        w._set_data((w + 1.0)._data)  # the donation rebind pattern
+        lazy.sync()
+        assert profiler.counters().get("naninf_donation_suppressed", 0) > before
+        assert profiler.counters().get("lazy_donated_buffers", 0) == donated
+
+
+class TestBackgroundCompile:
+    def test_miss_completes_via_replay_then_picks_up_compiled(self):
+        """Acceptance: a cache-miss step completes through the replay
+        fallback while the background compile finishes, and a later step
+        picks up the compiled executable (counter asserts)."""
+        paddle.set_flags({"FLAGS_lazy_bg_compile": True})
+        profiler.reset_counters()
+
+        def fn(a, b):
+            return a * b + jnp.sin(a)
+
+        vals = []
+        picked = False
+        for step in range(100):
+            x = jnp.full((64,), float(step))
+            y = jnp.full((64,), 2.0)
+            (out,), _ = lazy.record("bg_pickup_test", fn, [x, y], key=("bg_pickup_test",))
+            lazy.flush()
+            vals.append(float(np.asarray(out._concrete)[0]))
+            if profiler.counters().get("lazy_bg_pickups", 0) >= 1:
+                picked = True
+                break
+            time.sleep(0.05)
+        c = profiler.counters()
+        assert c.get("lazy_bg_compiles", 0) == 1
+        assert c.get("lazy_bg_replays", 0) >= 1  # the miss step ran via replay
+        assert picked, f"background compile never picked up: {c}"
+        expect = [s * 2.0 + np.sin(np.float64(s)) for s in range(len(vals))]
+        np.testing.assert_allclose(vals, expect, rtol=1e-6)
+
+    def test_bg_compile_off_by_default(self):
+        profiler.reset_counters()
+        t = paddle.to_tensor(np.ones(8, np.float32))
+        ((t + 3.0) * 2.0).numpy()
+        assert profiler.counters().get("lazy_bg_compiles", 0) == 0
+
+    def test_bg_compile_respects_async_kill_switch(self):
+        paddle.set_flags({"FLAGS_lazy_bg_compile": True, "FLAGS_lazy_async": False})
+        profiler.reset_counters()
+        t = paddle.to_tensor(np.ones(8, np.float32))
+        ((t - 5.0) / 2.0).numpy()
+        assert profiler.counters().get("lazy_bg_compiles", 0) == 0
+
+
+class _SeqDataset(paddle.io.Dataset):
+    def __init__(self, n=17, fail_at=None):
+        self.n = n
+        self.fail_at = fail_at
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if self.fail_at is not None and i == self.fail_at:
+            raise ValueError("boom")
+        return np.full((3,), i, np.float32)
+
+
+class TestDevicePrefetch:
+    def test_ordering_matches_unprefetched(self):
+        plain = [b.numpy() for b in paddle.io.DataLoader(_SeqDataset(), batch_size=4)]
+        pref = [
+            b.numpy()
+            for b in paddle.io.DataLoader(_SeqDataset(), batch_size=4, device_prefetch=2)
+        ]
+        assert len(plain) == len(pref) == 5
+        for a, b in zip(plain, pref):
+            np.testing.assert_array_equal(a, b)
+
+    def test_counter_and_device_residency(self):
+        before = profiler.counters().get("io_device_prefetched", 0)
+        it = iter(paddle.io.DataLoader(_SeqDataset(8), batch_size=4, device_prefetch=2))
+        b = next(it)
+        assert isinstance(b._data, jax.Array)  # already transferred, not lazy
+        it.close()
+        assert profiler.counters().get("io_device_prefetched", 0) > before
+
+    def test_shutdown_on_exhaustion_and_early_close(self):
+        it = iter(paddle.io.DataLoader(_SeqDataset(8), batch_size=4, device_prefetch=2))
+        assert len(list(it)) == 2
+        assert not it._thread.is_alive()
+        it2 = iter(paddle.io.DataLoader(_SeqDataset(100), batch_size=2, device_prefetch=2))
+        next(it2)
+        it2.close()
+        it2._thread.join(timeout=2.0)
+        assert not it2._thread.is_alive()
+        with pytest.raises(StopIteration):
+            next(it2)
+
+    def test_worker_error_propagates(self):
+        it = iter(
+            paddle.io.DataLoader(_SeqDataset(8, fail_at=5), batch_size=4, device_prefetch=2)
+        )
+        next(it)
+        with pytest.raises(ValueError, match="boom"):
+            next(it)
+        assert not it._thread.is_alive()
+
+    def test_engine_prefetch_commits_batch_sharding(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed.engine import HybridParallelEngine
+
+        devs = jax.devices()
+        mesh = Mesh(np.asarray(devs[: min(8, len(devs))]), ("dp",))
+        paddle.seed(0)
+        m = nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        eng = HybridParallelEngine(
+            m, opt, lambda mm, x, y: F.mse_loss(mm(x), y), mesh=mesh
+        )
+
+        class XY(paddle.io.Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return (
+                    np.full((8,), i, np.float32),
+                    np.zeros((4,), np.float32),
+                )
+
+        pf = eng.prefetch(paddle.io.DataLoader(XY(), batch_size=8), buffer_size=2)
+        x, y = next(pf)
+        # committed to the engine's dp batch sharding BEFORE the step ran
+        assert x._data.sharding == eng._batch_sharding(0, x._data)
+        loss = eng.train_step(x, y)
+        assert np.isfinite(float(loss.numpy()))
+        pf.close()
+
+
+class TestTripwire:
+    """Tier-1 tripwires for the async runtime (CI satellite)."""
+
+    def test_disabled_path_is_old_behavior(self, tmp_path, monkeypatch):
+        """FLAGS_lazy_async=0: in-flush synchronous NaN scan (active span
+        stack names lazy_flush at dump time, origin has no deferred tag), no
+        deferral, no block instrumentation."""
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        paddle.set_flags({"FLAGS_lazy_async": False, "FLAGS_check_nan_inf": True})
+        deferred = profiler.counters().get("lazy_deferred_checks", 0)
+        blocks = profiler.counters().get("lazy_blocks", 0)
+        w = paddle.to_tensor(np.zeros(4, np.float32))
+        t = paddle.log(w - 1.0)
+        with pytest.raises(FloatingPointError, match="log"):
+            t.numpy()
+        doc = json.load(open(flight.last_dump()))
+        assert any(s["name"] == "lazy_flush" for s in doc["active_spans"])
+        assert doc["extra"]["origin"] == "lazy flush"
+        assert "producing_span" not in doc["extra"]
+        assert profiler.counters().get("lazy_deferred_checks", 0) == deferred
+        assert profiler.counters().get("lazy_blocks", 0) == blocks
+
+    def test_no_block_spans_inside_lazy_flush(self):
+        """Span-stream grep: with async ON, the flush must only DISPATCH —
+        any blocking readback recorded inside a lazy_flush span (a future
+        accidental block_until_ready/np.asarray on the hot path) fails
+        here."""
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        paddle.seed(0)
+        m = MLP()
+        opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8, 16).astype("float32"))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 10, (8,)))
+        for _ in range(4):
+            loss = F.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            loss.item()
+        p.stop()
+        spans = profiler.span_events()
+        by_id = {s["span_id"]: s for s in spans}
+
+        def inside_flush(s):
+            while s["parent_id"]:
+                s = by_id.get(s["parent_id"])
+                if s is None:
+                    return False
+                if s["name"] == "lazy_flush":
+                    return True
+            return False
+
+        flushes = [s for s in spans if s["name"] == "lazy_flush"]
+        assert flushes, [s["name"] for s in spans][:20]
+        offenders = [s for s in spans if s["name"] == "block" and inside_flush(s)]
+        assert not offenders, offenders
+        # the async path was actually taken: cache hits DISPATCH
+        assert any(
+            s["name"] == "dispatch" and s["attrs"].get("cache") == "hit"
+            for s in spans
+        )
+
+    def test_lr_plateau_no_midstep_sync(self):
+        """optimizer/lr.py satellite: ReduceOnPlateau.step with a Python
+        float does no device readback at all; with a Tensor it flushes
+        (dispatch) first and the wait is attributed."""
+        sched = paddle.optimizer.lr.ReduceOnPlateau(learning_rate=0.1, patience=0)
+        blocks = profiler.counters().get("lazy_blocks", 0)
+        sched.step(1.0)
+        sched.step(2.0)  # worse -> lr drops, pure host floats
+        assert sched.last_lr < 0.1
+        assert profiler.counters().get("lazy_blocks", 0) == blocks
+        t = paddle.to_tensor(np.float32(3.0)) + 0.0  # lazy scalar
+        sched.step(t)
+        assert sched.best == pytest.approx(1.0)
+
+    def test_metric_update_single_sync(self):
+        """metric satellite: one update = one coalesced host sync (no
+        per-tensor np.asarray flushes splitting the fused step)."""
+        m = paddle.metric.Accuracy()
+        pred = paddle.to_tensor(
+            np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+        ) * 1.0  # lazy
+        label = paddle.to_tensor(np.array([1, 1], np.int64))
+        flushes0 = profiler.counters().get("lazy_flushes", 0)
+        correct = m.compute(pred, label)
+        m.update(correct)
+        flushes1 = profiler.counters().get("lazy_flushes", 0)
+        assert flushes1 - flushes0 <= 1  # the coalesced materialization
+        assert m.accumulate() == pytest.approx(0.5)
