@@ -38,7 +38,7 @@ from .core.place import (  # noqa: F401
     is_compiled_with_cuda, is_compiled_with_tpu,
 )
 from .core.engine import no_grad, enable_grad, set_grad_enabled, grad_enabled  # noqa: F401
-from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core.random import seed, get_rng_state, set_rng_state, program_rng  # noqa: F401
 
 # Ops (also monkey-patches Tensor methods) -----------------------------------
 from . import ops as _ops  # noqa: F401

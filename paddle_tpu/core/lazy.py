@@ -638,10 +638,16 @@ def _timed_block(x, where: str):
     ONLY sanctioned way the runtime waits on the device — the tier-1
     tripwire asserts no ``block`` span ever appears inside ``lazy_flush``."""
     from .dispatch import _prof
+    from ..distributed import watchdog as _watchdog
 
     t0 = time.perf_counter_ns()
     with _spans().span("block", where=where):
-        jax.block_until_ready(x)
+        # deadline on the host sync: a peer rank that died mid-step leaves
+        # this wait blocked forever in multi-controller runs — the watchdog
+        # (FLAGS_collective_timeout_s>0) converts that into an attributed
+        # resumable exit. A flag probe when disabled.
+        with _watchdog.guard(f"block:{where}"):
+            jax.block_until_ready(x)
     p = _prof()
     p.counter_inc("lazy_blocks")
     p.counter_inc("lazy_block_ns", time.perf_counter_ns() - t0)

@@ -69,3 +69,25 @@ def get_rng_state():
 
 def set_rng_state(key):
     _get().key = key
+
+
+class ProgramRNG:
+    """Checkpointable view of the global RNG stream: put ``program_rng`` in
+    a checkpoint tree (``{"model": m, "opt": o, "rng": program_rng}``) and a
+    resumed run continues the SAME key-split sequence — together with the
+    DataLoader's ``state_dict`` this is what makes an interrupted run replay
+    bit-identical steps (sample-exact resume)."""
+
+    def state_dict(self):
+        import numpy as np
+
+        return {"key": np.asarray(jax.random.key_data(_get().key))}
+
+    def set_state_dict(self, sd):
+        import jax.numpy as jnp
+
+        key = sd["key"]
+        _get().key = jnp.asarray(key, dtype=jnp.uint32)
+
+
+program_rng = ProgramRNG()

@@ -577,6 +577,353 @@ class AutoCheckpoint:
                 self._pending = None
 
 
+class CoordinatedCheckpoint:
+    """Multi-rank checkpointing with a store-mediated TWO-PHASE commit, so a
+    resume can never mix steps across ranks (ZeRO-1's engine-resident sharded
+    optimizer state makes a torn multi-rank checkpoint unreconstructable, not
+    merely stale).
+
+    Layout: ``<dir>/step_K/rank_R`` — each rank's shard saved through
+    :func:`save_state_dict` (per-rank manifest = that rank's durability
+    marker). Phase 1: every rank serializes + CRCs + writes, then acks on the
+    shared store. Phase 2: rank 0 waits for ``world_size`` acks, writes the
+    durable step commit marker (``<dir>/step_K/COMMITTED.json``, tmp +
+    ``os.replace``) and publishes the store commit record that releases the
+    waiting ranks. A crash at ANY point before the marker lands leaves the
+    step uncommitted on EVERY rank; resume walks past it.
+
+    Resume: newest-first over step dirs; a dir is eligible only when the
+    commit marker is present and every rank's manifest is committed. A dir
+    whose rank manifests disagree on the step they were written at is
+    corrupt-by-construction and rejected loudly (cross-rank manifest
+    agreement check), naming the disagreeing steps. When a store is bound,
+    ranks additionally publish the step they resolved and verify the whole
+    world agreed before loading.
+    """
+
+    COMMIT_MARKER = "COMMITTED.json"
+
+    def __init__(
+        self,
+        save_dir: str,
+        world_size: Optional[int] = None,
+        rank: Optional[int] = None,
+        store=None,
+        interval_steps: int = 100,
+        keep_last: int = 2,
+        commit_timeout_s: Optional[float] = None,
+        save_retries: int = 2,
+    ):
+        from .coord import CommitBarrier, store_from_env
+
+        self.save_dir = os.path.abspath(save_dir)
+        self.world_size = int(
+            world_size if world_size is not None
+            else os.environ.get("PADDLE_TRAINERS_NUM", "1")
+        )
+        self.rank = int(
+            rank if rank is not None else os.environ.get("PADDLE_TRAINER_ID", "0")
+        )
+        self.store = store if store is not None else store_from_env()
+        self.interval = int(interval_steps)
+        self.keep_last = keep_last
+        self.save_retries = int(save_retries)
+        self._commit_timeout_s = commit_timeout_s
+        self.barrier = (
+            CommitBarrier(self.store, self.world_size, self.rank, prefix="ckpt")
+            if self.store is not None else None
+        )
+        os.makedirs(self.save_dir, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.save_dir, f"step_{int(step)}")
+
+    def _rank_path(self, step: int, rank: Optional[int] = None) -> str:
+        return os.path.join(
+            self._step_dir(step), f"rank_{self.rank if rank is None else rank}"
+        )
+
+    def _marker_path(self, step: int) -> str:
+        return os.path.join(self._step_dir(step), self.COMMIT_MARKER)
+
+    def commit_timeout_s(self) -> float:
+        """Deadline for the commit barrier: explicit > watchdog flag > 60s.
+        A dead peer must fail the SAVE (uncommitted, training's caller
+        decides), never hang it."""
+        if self._commit_timeout_s is not None:
+            return float(self._commit_timeout_s)
+        from . import watchdog
+
+        t = watchdog.timeout_s()
+        return t if t > 0 else 60.0
+
+    # -- save --------------------------------------------------------------
+    def maybe_save(self, step: int, state_dict: Dict[str, Any]) -> bool:
+        if step == 0 or step % self.interval:
+            return False
+        return self.save_now(step, state_dict)
+
+    def save_now(self, step: int, state_dict: Dict[str, Any], sync: bool = True) -> bool:
+        """Run this rank's side of the coordinated save. Returns True when
+        the step COMMITTED (every rank acked and the marker landed); False
+        when the save failed or the barrier timed out — the step stays
+        invisible to resume, and the previous committed step remains the
+        recovery point. ``sync`` is accepted for AutoCheckpoint drop-in
+        compatibility (PreemptionGuard.drain): coordinated saves are always
+        synchronous — the commit barrier IS the durability point."""
+        from ..fault import inject as _inject
+        from ..fault.retry import retry_call
+        from ..profiler import flight as _flight
+        from . import watchdog
+        from .coord import DeadlineExceeded
+
+        watchdog.publish(step=step, phase="ckpt_save")
+        step = int(step)
+        try:
+            if self.barrier is not None and self.rank == 0:
+                # a crashed earlier attempt at THIS step (relaunch replayed
+                # to it) may have left acks/commit litter on the store;
+                # counting those would let the marker land before every rank
+                # of this attempt wrote durably — a torn-but-committed step
+                self.barrier.reset(step)
+            _inject.check("ckpt.serialize", step=step, rank=self.rank)
+            os.makedirs(self._step_dir(step), exist_ok=True)
+            retry_call(
+                save_state_dict,
+                state_dict,
+                self._rank_path(step),
+                async_save=False,
+                step=step,
+                retries=self.save_retries,
+                base_delay=0.05,
+            )
+            _inject.check("ckpt.ack", step=step, rank=self.rank)
+            if self.barrier is not None:
+                self.barrier.ack(step)
+                _inject.check("ckpt.commit", step=step, rank=self.rank)
+                if self.rank == 0:
+                    from .coord import wait_for
+
+                    wait_for(
+                        lambda: self.barrier.acks(step) >= self.world_size,
+                        f"coordinated ckpt acks (step {step})",
+                        self.commit_timeout_s(),
+                    )
+                    self._write_marker(step)
+                    self.barrier.commit(step, timeout_s=0.0)  # acks already in
+                else:
+                    from .coord import wait_for
+
+                    wait_for(
+                        lambda: self.barrier.committed(step)
+                        or os.path.exists(self._marker_path(step)),
+                        f"coordinated ckpt commit marker (step {step})",
+                        self.commit_timeout_s(),
+                    )
+            else:
+                # single-rank session (world 1, no store): the marker is the
+                # whole protocol
+                _inject.check("ckpt.commit", step=step, rank=self.rank)
+                self._write_marker(step)
+        except DeadlineExceeded as e:
+            _prof().counter_inc("ckpt_save_failures")
+            _flight.dump(
+                "coordinated_ckpt_timeout",
+                extra={"step": step, "rank": self.rank, "error": str(e)},
+            )
+            warnings.warn(
+                f"coordinated checkpoint at step {step} timed out "
+                f"(uncommitted, skipped): {e}"
+            )
+            return False
+        except Exception as e:
+            _prof().counter_inc("ckpt_save_failures")
+            _flight.dump(
+                "ckpt_save_failure",
+                extra={"step": step, "rank": self.rank, "phase": "coordinated",
+                       "error": repr(e)},
+            )
+            warnings.warn(
+                f"coordinated checkpoint at step {step} failed on rank "
+                f"{self.rank} (uncommitted, skipped): {e!r}"
+            )
+            return False
+        _prof().counter_inc("ckpt_coordinated_commits")
+        if self.rank == 0:
+            # resume-agreement votes describe the PREVIOUS world state; left
+            # behind, a later resume could read a peer's stale vote and
+            # spuriously reject. A committed step supersedes them.
+            if self.store is not None:
+                for r in range(self.world_size):
+                    try:
+                        self.store.delete_key(f"ckpt/resume/{r}")
+                    except Exception:
+                        pass
+            self._gc()
+        return True
+
+    def _write_marker(self, step: int) -> None:
+        """The step's durable commit record — written by rank 0 only after
+        every rank acked a durable, CRC'd shard."""
+        rec = {
+            "step": int(step), "ts": time.time(),
+            "world_size": self.world_size, "committed": True,
+        }
+        tmp = self._marker_path(step) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._marker_path(step))
+
+    # -- resume ------------------------------------------------------------
+    def _steps_on_disk(self) -> List[int]:
+        try:
+            names = os.listdir(self.save_dir)
+        except OSError:
+            return []
+        out = []
+        for d in names:
+            if d.startswith("step_") and d[len("step_"):].isdigit() \
+                    and os.path.isdir(os.path.join(self.save_dir, d)):
+                out.append(int(d[len("step_"):]))
+        return sorted(out, reverse=True)
+
+    def _rank_manifests(self, step: int) -> Dict[int, Optional[dict]]:
+        return {
+            r: read_manifest(self._rank_path(step, r))
+            for r in range(self.world_size)
+        }
+
+    def check_manifest_agreement(self, step: int) -> None:
+        """Cross-rank manifest agreement: every rank manifest present in the
+        step dir must have been written at the SAME step. Disagreement means
+        the directory mixes shards from different saves — unloadable by
+        construction (ZeRO shards from different steps are not a state), so
+        reject loudly instead of walking on."""
+        seen: Dict[int, List[int]] = {}
+        for r, man in self._rank_manifests(step).items():
+            if man is None or "step" not in man:
+                continue
+            seen.setdefault(int(man["step"]), []).append(r)
+        if len(seen) > 1:
+            detail = ", ".join(
+                f"step {s} (ranks {sorted(rs)})" for s, rs in sorted(seen.items())
+            )
+            raise CheckpointError(
+                f"checkpoint dir {self._step_dir(step)}: rank manifests "
+                f"disagree on the step they were written at — {detail}; "
+                "the directory mixes shards from different saves and cannot "
+                "be restored"
+            )
+
+    def _step_fully_committed(self, step: int) -> bool:
+        marker = self._marker_path(step)
+        try:
+            with open(marker) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if not rec.get("committed"):
+            return False
+        mans = self._rank_manifests(step)
+        return all(m is not None and m.get("committed") for m in mans.values())
+
+    def resume(self, state_dict: Dict[str, Any]) -> int:
+        """Load this rank's shard of the newest step EVERY rank committed;
+        returns that step or -1. Walks back past uncommitted/partial steps
+        (a crashed save); raises on a mixed-step directory (corruption the
+        protocol can't produce). With a store bound, the world additionally
+        agrees on the resolved step before anyone loads."""
+        fell_back = 0
+        for step in self._steps_on_disk():
+            self.check_manifest_agreement(step)
+            if not self._step_fully_committed(step):
+                fell_back += 1
+                continue
+            agreed = self._agree_on_resume_step(step)
+            try:
+                load_state_dict(
+                    state_dict, self._rank_path(step), strict=False, verify=True
+                )
+            except Exception as e:
+                if agreed:
+                    # the world already settled on this step — peers are
+                    # loading it NOW. Walking back here would silently mix
+                    # steps across ranks (the exact state the protocol
+                    # exists to prevent); fail loudly so the launcher
+                    # restarts the whole world instead.
+                    raise CheckpointError(
+                        f"rank {self.rank}: the world agreed to resume from "
+                        f"step {step} but this rank's shard failed to load "
+                        f"({e!r}); refusing to fall back to an older step "
+                        "while peers load the agreed one"
+                    ) from e
+                fell_back += 1
+                continue
+            if fell_back:
+                _prof().counter_inc("ckpt_resume_fallbacks", fell_back)
+            return step
+        if fell_back:
+            _prof().counter_inc("ckpt_resume_fallbacks", fell_back)
+        return -1
+
+    def _agree_on_resume_step(self, step: int) -> bool:
+        """Store-mediated resume agreement: each rank publishes the step it
+        resolved; disagreement (a rank seeing different fs state) raises
+        naming both. Returns True only when a full, unanimous agreement ran
+        — the caller then treats this step as BINDING (a local load failure
+        must raise, not walk back, because peers are loading it). Advisory
+        (False) when no store is bound or peers never showed up."""
+        if self.store is None:
+            return False
+        from .coord import DeadlineExceeded, wait_for
+
+        key = f"ckpt/resume/{self.rank}"
+        self.store.set(key, str(int(step)))
+
+        def all_published():
+            return all(
+                self.store.get(f"ckpt/resume/{r}") is not None
+                for r in range(self.world_size)
+            )
+
+        try:
+            wait_for(all_published, "resume-step agreement", self.commit_timeout_s())
+        except DeadlineExceeded:
+            warnings.warn(
+                "resume-step agreement timed out (peers absent); proceeding "
+                f"with locally-resolved step {step}"
+            )
+            return False
+        votes = {
+            r: int(self.store.get(f"ckpt/resume/{r}"))
+            for r in range(self.world_size)
+        }
+        if len(set(votes.values())) > 1:
+            raise CheckpointError(
+                f"ranks disagree on the resume step: {votes} — refusing to "
+                "mix steps across ranks"
+            )
+        return True
+
+    # -- GC ----------------------------------------------------------------
+    def _gc(self) -> None:
+        """Rank 0 only: drop old step dirs, but never the newest fully
+        committed one (the only recovery point if later saves turn out
+        torn)."""
+        steps = sorted(self._steps_on_disk())
+        keep = set(steps[-self.keep_last:]) if self.keep_last else set(steps)
+        committed = [s for s in steps if self._step_fully_committed(s)]
+        if committed:
+            keep.add(committed[-1])
+        for s in steps:
+            if s in keep:
+                continue
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+
 def engine_state_dict(engine) -> Dict[str, Any]:
     """Checkpointable view of a HybridParallelEngine: params + opt accums,
     all kept in their sharded placements. For SAVING; to restore use
@@ -624,5 +971,6 @@ def engine_load_state_dict(engine, path) -> None:
 
 __all__ = [
     "save_state_dict", "load_state_dict", "AutoCheckpoint", "CheckpointError",
-    "read_manifest", "engine_state_dict", "engine_load_state_dict",
+    "CoordinatedCheckpoint", "read_manifest", "engine_state_dict",
+    "engine_load_state_dict",
 ]

@@ -279,12 +279,22 @@ def irecv(tensor, src=0, group=None):
 
 
 def barrier(group=None):
-    jax.effects_barrier()
+    # the watchdog guard is a float compare when FLAGS_collective_timeout_s
+    # is 0 (no thread, no sync); armed, an effects barrier that never
+    # returns — a dead peer in a multi-controller world — trips the deadline
+    # and exits resumably instead of hanging the job forever
+    from . import watchdog
+
+    with watchdog.guard("barrier"):
+        jax.effects_barrier()
 
 
 def wait(tensor, group=None, use_calc_stream=True):
     if isinstance(tensor, Tensor) and not _is_traced(tensor._data):
-        tensor._data.block_until_ready()
+        from . import watchdog
+
+        with watchdog.guard("wait"):
+            tensor._data.block_until_ready()
 
 
 def split(x, num_partitions, axis=0, group=None):

@@ -1,0 +1,215 @@
+"""Coordination substrate: store abstraction + two-phase commit barrier.
+
+The watchdog and the coordinated checkpoint protocol both need a small KV
+store shared by every rank. Production launches have the native C++ TCPStore
+(runtime_cpp/tcp_store.cc, the etcd analogue); this module adds a
+**FileStore** with the same ``set/get/add/delete_key`` surface over a shared
+directory, so single-host multi-process jobs (``spawn``) and the chaos tests
+coordinate without the native lib — and so a dead store can never be the
+reason recovery itself hangs: every wait here carries a deadline.
+
+``CommitBarrier`` is the store-mediated two-phase barrier behind
+checkpoint.CoordinatedCheckpoint: phase 1 collects one ack per rank (each
+rank's shard is serialized, CRC'd and durable), phase 2 publishes a single
+commit record observed by every rank. A crash at ANY point before phase 2
+leaves the step uncommitted on every rank — resume can never mix steps.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "FileStore", "CommitBarrier", "DeadlineExceeded", "wait_for",
+    "store_from_env",
+]
+
+
+class DeadlineExceeded(TimeoutError):
+    """A coordinated wait ran past its deadline. Carries ``what`` (which
+    wait) and ``waited_s`` so the flight dump / error names the stall."""
+
+    def __init__(self, what: str, waited_s: float, detail: str = ""):
+        super().__init__(
+            f"deadline exceeded after {waited_s:.1f}s waiting for {what}"
+            + (f" ({detail})" if detail else "")
+        )
+        self.what = what
+        self.waited_s = waited_s
+
+
+def wait_for(
+    poll: Callable[[], bool],
+    what: str,
+    timeout_s: float,
+    interval_s: float = 0.05,
+    on_timeout: Optional[Callable[[], None]] = None,
+) -> None:
+    """Poll ``poll()`` until truthy or ``timeout_s`` elapses. The
+    interruptible-wait analogue of watchdog.guard for store round-trips:
+    polling loops need no monitor thread — the loop itself owns the clock.
+    ``timeout_s<=0`` means no deadline (poll forever)."""
+    t0 = time.monotonic()
+    while not poll():
+        if timeout_s > 0 and time.monotonic() - t0 > timeout_s:
+            if on_timeout is not None:
+                on_timeout()
+            raise DeadlineExceeded(what, time.monotonic() - t0)
+        time.sleep(interval_s)
+
+
+class FileStore:
+    """TCPStore-shaped KV over a shared directory (single host / shared fs).
+
+    Writes are atomic (tmp + ``os.replace``); ``add`` uses a lock directory
+    (``os.mkdir`` is atomic on POSIX) so concurrent increments from N ranks
+    serialize. Keys map to files with ``/`` escaped, so the store survives
+    arbitrary key grammars without creating directory trees.
+    """
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        os.makedirs(self.path, exist_ok=True)
+
+    def _file(self, key: str) -> str:
+        return os.path.join(self.path, key.replace("/", "%2f"))
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        f = self._file(key)
+        fd, tmp = tempfile.mkstemp(dir=self.path, prefix=".tmp_")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(value)
+            os.replace(tmp, f)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    def get(self, key: str):
+        try:
+            with open(self._file(key), "rb") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+    def add(self, key: str, amount: int = 1) -> int:
+        lock = self._file(key) + ".lock"
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                os.mkdir(lock)
+                break
+            except FileExistsError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"FileStore.add: lock stuck for {key!r}")
+                time.sleep(0.002)
+        try:
+            raw = self.get(key)
+            cur = int(raw) if raw else 0
+            cur += int(amount)
+            self.set(key, str(cur))
+            return cur
+        finally:
+            os.rmdir(lock)
+
+    def delete_key(self, key: str) -> None:
+        try:
+            os.remove(self._file(key))
+        except OSError:
+            pass
+
+    def keys(self):
+        """All keys currently present (FileStore extension, used by the
+        progress table to enumerate ranks)."""
+        out = []
+        for name in os.listdir(self.path):
+            if name.startswith(".tmp_") or name.endswith(".lock"):
+                continue
+            out.append(name.replace("%2f", "/"))
+        return out
+
+    def close(self) -> None:
+        pass
+
+
+def store_from_env() -> Optional[FileStore]:
+    """The rank-shared store named by ``PADDLE_TPU_STORE_DIR`` (set by spawn
+    / the chaos harness for its children), or None."""
+    d = os.environ.get("PADDLE_TPU_STORE_DIR")
+    return FileStore(d) if d else None
+
+
+class CommitBarrier:
+    """Two-phase commit over a store (TCPStore or FileStore).
+
+    Phase 1 — ``ack(tag)``: this rank's local work for ``tag`` (a checkpoint
+    step) is durable. Phase 2 — rank 0 waits for ``world_size`` acks and
+    publishes the commit record; every other rank waits for it. Distinct
+    tags are independent, so a retried save at a later step never collides
+    with litter from a crashed earlier attempt.
+    """
+
+    def __init__(self, store, world_size: int, rank: int, prefix: str = "commit"):
+        self.store = store
+        self.world_size = int(world_size)
+        self.rank = int(rank)
+        self.prefix = prefix
+
+    def _ack_key(self, tag) -> str:
+        return f"{self.prefix}/{tag}/acks"
+
+    def _commit_key(self, tag) -> str:
+        return f"{self.prefix}/{tag}/commit"
+
+    def ack(self, tag) -> int:
+        return self.store.add(self._ack_key(tag), 1)
+
+    def reset(self, tag) -> None:
+        """Clear litter a crashed earlier attempt left behind for ``tag``
+        (stale acks / commit record). Rank 0 calls this when it ENTERS a
+        save attempt, before serializing: without it, a relaunched job
+        replaying to the same step would find the dead attempt's acks and
+        commit before every rank of the new attempt has written durably —
+        a torn checkpoint with a valid marker. Peers ack only after their
+        own serialize+write completes, so in a lockstep world the reset
+        strictly precedes this attempt's acks; losing that race merely
+        times the save out (uncommitted, safe, retried next interval)."""
+        self.store.delete_key(self._ack_key(tag))
+        self.store.delete_key(self._commit_key(tag))
+
+    def acks(self, tag) -> int:
+        raw = self.store.get(self._ack_key(tag))
+        return int(raw) if raw else 0
+
+    def committed(self, tag) -> bool:
+        return self.store.get(self._commit_key(tag)) is not None
+
+    def commit(self, tag, timeout_s: float, payload: Optional[dict] = None) -> dict:
+        """Run this rank's side of the two-phase commit for ``tag``. Returns
+        the commit record. Raises :class:`DeadlineExceeded` when the other
+        ranks never arrive — the caller (coordinated save) treats that as a
+        failed, UNcommitted save and walks on."""
+        if self.rank == 0:
+            wait_for(
+                lambda: self.acks(tag) >= self.world_size,
+                f"commit barrier acks ({self.prefix}/{tag})",
+                timeout_s,
+            )
+            rec = {"tag": str(tag), "ts": time.time(),
+                   "world_size": self.world_size, **(payload or {})}
+            self.store.set(self._commit_key(tag), json.dumps(rec))
+            return rec
+        wait_for(
+            lambda: self.committed(tag),
+            f"commit marker ({self.prefix}/{tag})",
+            timeout_s,
+        )
+        return json.loads(self.store.get(self._commit_key(tag)))

@@ -327,7 +327,14 @@ class HybridParallelEngine:
     @no_grad()
     def train_step(self, *batch):
         from ..profiler import spans as _spans
+        from . import watchdog
 
+        # progress publication for the distributed watchdog: a peer that
+        # stops stepping is attributable from this table. No-op (two attr
+        # checks) when no supervision session is configured.
+        watchdog.publish(
+            step=getattr(self.optimizer, "_step_count", None), phase="train_step"
+        )
         with _spans.span("train_step", kind="engine") as sp:
             return self._train_step_impl(sp, *batch)
 

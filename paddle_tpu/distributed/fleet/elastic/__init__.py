@@ -95,9 +95,19 @@ class ElasticManager:
         return self
 
     def _beat(self):
-        self._store_op(
-            self.store.set, self._hb_key(self.worker_id), json.dumps({"ts": time.time()})
-        )
+        # the heartbeat is progress-AWARE: it carries the rank's last
+        # published (step, phase, span) record, so the watcher can tell a
+        # live-but-stuck rank (fresh ts, stale step) from a dead one (stale
+        # ts) and the watchdog can name the straggler
+        rec = {"ts": time.time()}
+        try:
+            from ...watchdog import local_progress
+
+            rec.update(local_progress())
+            rec["ts"] = time.time()  # heartbeat freshness wins over publish ts
+        except Exception:
+            pass
+        self._store_op(self.store.set, self._hb_key(self.worker_id), json.dumps(rec))
 
     def _hb_loop(self):
         # each _beat already retries with backoff; only give up (and let the
@@ -141,6 +151,25 @@ class ElasticManager:
             if now - ts <= self.timeout:
                 alive.append(wid)
         return alive
+
+    def progress(self, known_ids: List[str]) -> Dict[str, dict]:
+        """Watcher-side view of every worker's last heartbeat record
+        (ts + the rank's step/phase/span progress): the launcher includes
+        this in its failure report so a dead rank's last known position
+        survives the relaunch."""
+        out: Dict[str, dict] = {}
+        for wid in known_ids:
+            try:
+                raw = self._store_op(self.store.get, self._hb_key(wid))
+            except Exception:
+                continue
+            if not raw:
+                continue
+            try:
+                out[wid] = json.loads(raw)
+            except Exception:
+                continue
+        return out
 
     def watch(self, known_ids: List[str]) -> ElasticStatus:
         """One watch tick (reference manager.py:398 watch loop)."""

@@ -126,6 +126,33 @@ def launch(
     coordinator = f"{ip_list[0]}:{coordinator_port}"
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
+    # supervision substrate: workers publish watchdog progress here (and the
+    # coordinated-checkpoint FileStore lives beside it), so the launcher's
+    # failure report can name each dead rank's last known position
+    import tempfile
+
+    supervise_root = (
+        os.path.join(log_dir, "supervise") if log_dir
+        else tempfile.mkdtemp(prefix="paddle_tpu_supervise_")
+    )
+    progress_dir = os.path.join(supervise_root, "progress")
+    store_dir = os.path.join(supervise_root, "store")
+    os.makedirs(progress_dir, exist_ok=True)
+    os.makedirs(store_dir, exist_ok=True)
+
+    def _progress_report():
+        try:
+            from .watchdog import _read_progress_dir
+
+            table = _read_progress_dir(progress_dir)
+        except Exception:
+            return ""
+        if not table:
+            return ""
+        return " | last progress: " + "; ".join(
+            f"rank {r}: step {rec.get('step')} phase {rec.get('phase')!r}"
+            for r, rec in sorted(table.items())
+        )
 
     def spawn_all(_ids=None, _elastic_port=None):
         procs = {}
@@ -141,6 +168,8 @@ def launch(
                     "PADDLE_TRAINER_ENDPOINTS": ",".join(cluster.trainer_endpoints()),
                     "PADDLE_NODE_RANK": str(pod.node_rank),
                     "PADDLE_NNODES": str(len(cluster.pods)),
+                    "PADDLE_TPU_PROGRESS_DIR": progress_dir,
+                    "PADDLE_TPU_STORE_DIR": store_dir,
                 }
             )
             if _elastic_port is not None:
@@ -187,7 +216,9 @@ def launch(
     while True:
         codes = {w: p.wait() for w, p in procs.items()}
         if any(c not in (0, RESUMABLE_EXIT_CODE) for c in codes.values()):
-            raise RuntimeError(f"workers exited with codes {codes}")
+            raise RuntimeError(
+                f"workers exited with codes {codes}{_progress_report()}"
+            )
         if all(c == 0 for c in codes.values()):
             return 0
         # preemption drains are normal operations, not failures: same

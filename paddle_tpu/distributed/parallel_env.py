@@ -8,14 +8,40 @@ comm-id plumbing; rank/world come from the PJRT process topology.
 from __future__ import annotations
 
 import os
+import time
+import warnings
 
 import jax
 
 _init_done = False
+_last_hb_warn = 0.0
+_HB_WARN_INTERVAL_S = 60.0
 
 
 def _initialized():
     return _init_done
+
+
+def _warn_heartbeat_failure(e: Exception) -> None:
+    """Advisory degradation made VISIBLE: heartbeat registration failing
+    means the elastic watcher will see this worker as dead even while it
+    trains — rate-limited warning + counter instead of a silent pass."""
+    global _last_hb_warn
+    try:
+        from .. import profiler
+
+        profiler.counter_inc("heartbeat_failures")
+    except Exception:
+        pass
+    now = time.monotonic()
+    if now - _last_hb_warn >= _HB_WARN_INTERVAL_S:
+        _last_hb_warn = now
+        warnings.warn(
+            f"elastic heartbeat registration failed ({e!r}); training "
+            "proceeds but the elastic watcher cannot see this worker — it "
+            "may be declared dead and the job relaunched",
+            RuntimeWarning,
+        )
 
 
 def init_parallel_env():
@@ -43,6 +69,15 @@ def init_parallel_env():
                 num_processes=n,
                 process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
             )
+    # distributed supervision: bind the watchdog session (rank/world from
+    # the launcher env; progress store/dir when provided). Progress-aware
+    # heartbeats + guarded collectives need this; with no launcher env it
+    # is a 1-rank session that never publishes anywhere.
+    from . import watchdog
+
+    watchdog.configure(
+        rank=int(os.environ.get("PADDLE_TRAINER_ID", "0")), world_size=n
+    )
     # elastic mode: register this worker's heartbeat on the elastic store
     est = os.environ.get("PADDLE_ELASTIC_STORE")
     wid = os.environ.get("PADDLE_ELASTIC_WORKER_ID")
@@ -54,8 +89,11 @@ def init_parallel_env():
             host, _, port = est.partition(":")
             store = TCPStore(host=host, port=int(port), is_master=False)
             ElasticManager(store, n, worker_id=wid).register()
-        except Exception:
-            pass  # heartbeat is advisory; training proceeds without it
+        except Exception as e:
+            # heartbeat is advisory — training proceeds — but the
+            # degradation must be visible (rate-limited warning +
+            # heartbeat_failures counter), not a silent pass
+            _warn_heartbeat_failure(e)
     _init_done = True
     return ParallelEnv()
 

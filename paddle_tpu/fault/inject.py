@@ -21,14 +21,22 @@ Keys (all optional; values are ints except ``op``):
   failures that must defeat the retry helper).
 * ``step=K``  — fire when the call context carries ``step == K``.
 * ``op=NAME`` — only calls whose context carries ``op == NAME`` match.
+* ``rank=R``  — only calls whose context carries ``rank == R`` match (chaos
+  specs shared by a whole world target one rank).
 * ``call=N``  — with ``op=``: the Nth call of that op (alias of ``at``).
 * ``times=M`` — fire at most M times total (default: unlimited).
+* ``ms=N``    — ``rank.slow`` payload: straggler delay in milliseconds.
+* ``exit=N``  — ``rank.kill`` payload: exit code (default 137).
 
-Failure-type points (``store.op``, ``ckpt.write``) raise
-:class:`InjectedFault` (an ``OSError``, so the shared retry helper treats it
-as transient); ``preempt.sigterm`` delivers a real SIGTERM;
-``tensor.nan`` overwrites the first element of the named op's output with
-NaN (threaded through eager and lazy dispatch).
+Failure-type points (``store.op``, ``ckpt.write``, ``ckpt.serialize``,
+``ckpt.ack``, ``ckpt.commit``) raise :class:`InjectedFault` (an ``OSError``,
+so the shared retry helper treats it as transient); ``preempt.sigterm``
+delivers a real SIGTERM; ``tensor.nan`` overwrites the first element of the
+named op's output with NaN (threaded through eager and lazy dispatch).
+Chaos points (``rank.kill`` / ``rank.hang`` / ``rank.slow`` /
+``collective.drop``) execute their action in-process via :func:`chaos` /
+:func:`chaos_drop`, threaded through the distributed watchdog's progress
+publications and guarded collectives.
 """
 from __future__ import annotations
 
@@ -43,6 +51,15 @@ POINTS: Dict[str, str] = {
     "ckpt.write": "distributed/checkpoint.py save_state_dict write path",
     "preempt.sigterm": "PreemptionGuard.check(step=k) — SIGTERM at step k",
     "tensor.nan": "core/dispatch.py eager_call — NaN into a named op's output",
+    # -- chaos points (distributed watchdog harness) --------------------------
+    "rank.kill": "watchdog.publish — hard-kill this rank (os._exit, default 137)",
+    "rank.hang": "watchdog.publish — wedge this rank in a sleep loop forever",
+    "rank.slow": "watchdog.publish — straggler delay (ms=N, default 1000)",
+    "collective.drop": "watchdog.guard enter — this rank never joins the collective",
+    # -- coordinated-commit crash points (checkpoint.CoordinatedCheckpoint) ---
+    "ckpt.serialize": "coordinated save — crash during state serialization",
+    "ckpt.ack": "coordinated save — crash after durable write, before the ack",
+    "ckpt.commit": "coordinated save — crash between full acks and the commit record",
 }
 
 
@@ -138,10 +155,11 @@ def armed() -> bool:
     return _armed
 
 
-def should_fire(point: str, step: Optional[int] = None, op: Optional[str] = None) -> bool:
+def should_fire(point: str, step: Optional[int] = None, op: Optional[str] = None,
+                rank: Optional[int] = None) -> bool:
     """Deterministically decide whether ``point`` fires for this call.
-    Counts only calls that pass the ``op=`` filter, so ``at=N`` means "the
-    Nth call of that op" regardless of unrelated traffic."""
+    Counts only calls that pass the ``op=``/``rank=`` filters, so ``at=N``
+    means "the Nth call of that op/rank" regardless of unrelated traffic."""
     if point not in POINTS:
         raise KeyError(f"unknown injection point {point!r}; known: {sorted(POINTS)}")
     if not _armed:
@@ -151,6 +169,8 @@ def should_fire(point: str, step: Optional[int] = None, op: Optional[str] = None
         if cfg is None:
             return False
         if "op" in cfg and op != cfg["op"]:
+            return False
+        if "rank" in cfg and (rank is None or int(rank) != cfg["rank"]):
             return False
         n = _calls.get(point, 0) + 1
         _calls[point] = n
@@ -174,9 +194,61 @@ def should_fire(point: str, step: Optional[int] = None, op: Optional[str] = None
 
 def check(point: str, **ctx) -> None:
     """Raise :class:`InjectedFault` when ``point`` fires (failure-type call
-    sites: store ops, checkpoint writes)."""
-    if should_fire(point, step=ctx.get("step"), op=ctx.get("op")):
+    sites: store ops, checkpoint writes, coordinated-commit phases)."""
+    if should_fire(point, step=ctx.get("step"), op=ctx.get("op"), rank=ctx.get("rank")):
         raise InjectedFault(point, ctx)
+
+
+def point_cfg(point: str) -> dict:
+    """The armed config dict for ``point`` ({} when not armed) — payload
+    keys like ``ms=`` / ``exit=`` that parameterize the chaos actions."""
+    with _lock:
+        return dict(_active.get(point) or {})
+
+
+# -- chaos actions (rank.* / collective.drop payloads) -----------------------
+def _hang(point: str) -> None:
+    """Wedge this process: the canonical hung-rank simulation. Announces on
+    stderr (the parent's logs show WHY the rank went silent), then sleeps
+    until killed — it never returns."""
+    import sys as _sys
+    import time as _time
+
+    _sys.stderr.write(f"paddle_tpu.fault.inject: '{point}' fired — rank wedged\n")
+    _sys.stderr.flush()
+    while True:
+        _time.sleep(3600)
+
+
+def chaos(step: Optional[int] = None, rank: Optional[int] = None,
+          phase: Optional[str] = None) -> None:
+    """Consult the ``rank.*`` chaos points (threaded through
+    ``watchdog.publish`` at every step/phase boundary). ``rank.slow`` sleeps
+    ``ms=`` milliseconds (default 1000); ``rank.hang`` wedges forever;
+    ``rank.kill`` hard-exits with ``exit=`` (default 137 — SIGKILL's shell
+    code, NOT resumable: the launcher sees a real failure)."""
+    import time as _time
+
+    if not _armed:
+        return
+    if should_fire("rank.slow", step=step, rank=rank):
+        _time.sleep(point_cfg("rank.slow").get("ms", 1000) / 1000.0)
+    if should_fire("rank.hang", step=step, rank=rank):
+        _hang("rank.hang")
+    if should_fire("rank.kill", step=step, rank=rank):
+        import sys as _sys
+
+        code = point_cfg("rank.kill").get("exit", 137)
+        _sys.stderr.write(f"paddle_tpu.fault.inject: 'rank.kill' fired — exit {code}\n")
+        _sys.stderr.flush()
+        os._exit(code)
+
+
+def chaos_drop(rank: Optional[int] = None, step: Optional[int] = None) -> None:
+    """``collective.drop``: wedge this rank right before it would enter a
+    guarded collective — its peers block until their watchdog deadline."""
+    if _armed and should_fire("collective.drop", step=step, rank=rank):
+        _hang("collective.drop")
 
 
 def exercised() -> set:
@@ -231,5 +303,6 @@ _arm_from_env()
 
 __all__ = [
     "POINTS", "InjectedFault", "arm", "disarm", "armed", "should_fire",
-    "check", "exercised", "fired_counts", "poison_first_nan",
+    "check", "exercised", "fired_counts", "poison_first_nan", "point_cfg",
+    "chaos", "chaos_drop",
 ]

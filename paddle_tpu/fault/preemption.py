@@ -124,6 +124,14 @@ class PreemptionGuard:
         async mode)."""
         from ..core import lazy
 
+        try:
+            from ..distributed import watchdog
+
+            # peers (and the post-mortem progress table) see this rank leave
+            # through a drain, not silently stop stepping
+            watchdog.publish(step=step, phase="preempt_drain", force=True)
+        except Exception:
+            pass
         lazy.flush()
         _counter("preemption_drains")
         try:

@@ -161,6 +161,24 @@ class WeightedRandomSampler(Sampler):
         return self.num_samples
 
 
+class _SeededRandomSampler(Sampler):
+    """Shuffle that is a pure function of ``(seed, epoch)`` — the property
+    sample-exact resume needs: an interrupted run that reloads the loader's
+    ``state_dict`` replays bit-identical batch order, because nothing about
+    the permutation depends on ambient global RNG state at iteration time."""
+
+    def __init__(self, data_source, seed: int, epoch_fn):
+        super().__init__(data_source)
+        self.seed = int(seed)
+        self._epoch_fn = epoch_fn  # () -> current epoch (owned by the loader)
+
+    def __iter__(self):
+        # distinct, decorrelated stream per (seed, epoch); SeedSequence does
+        # the mixing so seed=0/epoch=1 and seed=1/epoch=0 don't collide
+        rng = np.random.default_rng(np.random.SeedSequence([self.seed, self._epoch_fn()]))
+        return iter(rng.permutation(len(self.data_source)).tolist())
+
+
 class BatchSampler(Sampler):
     def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1, drop_last=False):
         self.batch_size = batch_size
@@ -332,7 +350,8 @@ class _MultiprocessIter:
         self.index_q = ctx.Queue()
         self.data_q = ctx.Queue()
         self.n_batches = 0
-        for i, indices in enumerate(iter(loader.batch_sampler)):
+        index_iter, _ = loader._index_iter()
+        for i, indices in enumerate(index_iter):
             self.index_q.put((i, list(indices)))
             self.n_batches = i + 1
         for _ in range(loader.num_workers):
@@ -411,7 +430,7 @@ class _DataLoaderIter:
 
     def __init__(self, loader):
         self.loader = loader
-        self.batch_sampler_iter = iter(loader.batch_sampler)
+        self.batch_sampler_iter, _ = loader._index_iter()
         self.num_workers = loader.num_workers
         self.collate_fn = loader.collate_fn or default_collate_fn
         self.done = False
@@ -504,6 +523,14 @@ class _IterableIter:
         self.it = iter(loader.dataset)
         self.collate_fn = loader.collate_fn or default_collate_fn
         self.batch_size = loader.batch_size
+        # resume fast-forward: an IterableDataset has no index space, so the
+        # skip must CONSUME skipped samples (map-style loaders skip at the
+        # index level instead)
+        skip, loader._resume_skip = loader._resume_skip, 0
+        if skip and self.batch_size:
+            next(itertools.islice(self.it, skip * self.batch_size - 1,
+                                  skip * self.batch_size), None)
+        loader._batch_idx = skip
 
     def __iter__(self):
         return self
@@ -549,8 +576,10 @@ class DevicePrefetcher:
         import jax
 
         self._jax = jax
+        self._source = it
         self._it = iter(it)
         self._sharding = sharding
+        self._consumed = 0  # batches handed to the trainer (NOT read-ahead)
         self._q: _queue.Queue = _queue.Queue(maxsize=max(1, int(buffer_size)))
         self._stop = threading.Event()
         # The worker must NOT hold a strong ref to self (a bound-method
@@ -648,7 +677,70 @@ class DevicePrefetcher:
         if kind == "err":
             self.close()
             raise payload
+        self._consumed += 1
         return payload
+
+    # -- sample-exact resume ------------------------------------------------
+    def _epoch_iter(self):
+        """The _StatefulIter under this prefetcher, if the source is (or
+        yields) one — the object that can translate a consumed-count into a
+        loader position."""
+        for cand in (self._it, self._source):
+            if isinstance(cand, _StatefulIter):
+                return cand
+        return None
+
+    def state_dict(self) -> dict:
+        """Loader position as of the last batch the TRAINER consumed. The
+        underlying loader's own counter runs ahead by the staged read-ahead;
+        this corrects for it, so a checkpoint taken mid-prefetch resumes at
+        the right batch."""
+        ei = self._epoch_iter()
+        if ei is None:
+            raise TypeError(
+                "DevicePrefetcher.state_dict: source iterator does not track "
+                "loader position (wrap a DataLoader, not a bare iterable)"
+            )
+        return ei.state_at(self._consumed)
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Rebind to the source loader's restored position. Tears down the
+        current read-ahead (those staged batches belong to the pre-restore
+        position) and restarts prefetch from the fast-forwarded iterator."""
+        loader = getattr(self._epoch_iter() or self._source, "loader", None)
+        if loader is None or not callable(getattr(loader, "load_state_dict", None)):
+            raise TypeError(
+                "DevicePrefetcher.load_state_dict: no underlying DataLoader "
+                "to restore into"
+            )
+        self.close()
+        loader.load_state_dict(sd)
+        self._consumed = 0
+        # rebind to the position-tracking iterator DIRECTLY: iter(loader) on
+        # a device_prefetch>0 loader would return a nested prefetcher whose
+        # worker starts staging batches immediately — adopting its inner
+        # iterator after the fact drops whatever it already staged
+        make = getattr(loader, "_stateful_iter", None)
+        self._it = make() if callable(make) else iter(loader)
+        if isinstance(self._it, DevicePrefetcher):
+            # foreign loader whose __iter__ returns its own prefetcher:
+            # tear it down before adopting (staged batches are discarded —
+            # better than two racing prefetch threads on one iterator)
+            inner = self._it
+            self._it = inner._it
+            inner.close()
+        self._source = self._it
+        self._q = _queue.Queue(maxsize=self._q.maxsize)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=DevicePrefetcher._loop,
+            args=(weakref.ref(self), self._it, self._q, self._stop),
+            daemon=True,
+            name="device-prefetch",
+        )
+        self._thread.start()
+
+    set_state_dict = load_state_dict
 
     def close(self):
         """Stop the prefetch thread (idempotent). Staged batches are
@@ -675,6 +767,45 @@ def device_prefetch(it, buffer_size=2, sharding=None):
     return DevicePrefetcher(it, buffer_size=buffer_size, sharding=sharding)
 
 
+class _StatefulIter:
+    """Epoch iterator that keeps the owning loader's ``(epoch, batch_idx)``
+    position current as batches are handed out — the bookkeeping behind
+    ``DataLoader.state_dict`` (sample-exact resume). Exhaustion rolls the
+    loader to the next epoch at batch 0."""
+
+    def __init__(self, loader, inner, start_batch_idx):
+        self.loader = loader
+        self.inner = inner
+        self._start_epoch = loader._epoch
+        self._start_idx = int(start_batch_idx)
+        self._produced = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self.inner)
+        except StopIteration:
+            self.loader._epoch += 1
+            self.loader._batch_idx = 0
+            raise
+        self._produced += 1
+        self.loader._batch_idx = self._start_idx + self._produced
+        return batch
+
+    def state_at(self, consumed: int) -> dict:
+        """Loader position as of ``consumed`` batches handed out by THIS
+        epoch iterator — what DevicePrefetcher reports, because its read-
+        ahead makes the loader's own counter run early."""
+        seed = self.loader.seed
+        return {
+            "epoch": self._start_epoch,
+            "batch_idx": self._start_idx + int(consumed),
+            "seed": -1 if seed is None else int(seed),
+        }
+
+
 class DataLoader:
     def __init__(
         self,
@@ -696,6 +827,7 @@ class DataLoader:
         persistent_workers=False,
         use_multiprocess=None,
         device_prefetch=0,
+        seed=None,
     ):
         self.dataset = dataset
         self.return_list = return_list
@@ -717,18 +849,96 @@ class DataLoader:
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
+        # sample-exact resume: with an explicit seed the shuffle is a pure
+        # function of (seed, epoch), so state_dict/load_state_dict replays
+        # bit-identical batch order. seed=None keeps the legacy global-RNG
+        # shuffle (positions still tracked, order not reproducible).
+        self.seed = None if seed is None else int(seed)
+        self._epoch = 0
+        self._batch_idx = 0
+        self._resume_skip = 0
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
         elif batch_sampler is not None:
             self.batch_sampler = batch_sampler
         else:
+            sampler = (
+                _SeededRandomSampler(dataset, self.seed, lambda: self._epoch)
+                if (shuffle and self.seed is not None) else None
+            )
             self.batch_sampler = BatchSampler(
-                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+                dataset, sampler=sampler, shuffle=shuffle,
+                batch_size=batch_size, drop_last=drop_last,
             )
 
-    def __iter__(self):
+    # -- sample-exact resume ------------------------------------------------
+    def state_dict(self) -> dict:
+        """Loader position: ``(epoch, batch_idx, seed)``. Save it alongside
+        the model/optimizer tree; a reloaded loader fast-forwards to the same
+        batch — bit-identical order when the loader was built with ``seed``.
+        When iterating through a DevicePrefetcher, use ITS ``state_dict()``
+        (read-ahead means the loader's counter runs early)."""
+        return {
+            "epoch": int(self._epoch),
+            "batch_idx": int(self._batch_idx),
+            # -1 = no seed (legacy global-RNG shuffle) — kept numeric so the
+            # record survives array-normalizing checkpoint trees
+            "seed": -1 if self.seed is None else int(self.seed),
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        self._epoch = int(sd.get("epoch", 0))
+        self._batch_idx = 0
+        self._resume_skip = int(sd.get("batch_idx", 0))
+        saved_seed = sd.get("seed")
+        if saved_seed is not None:
+            saved_seed = int(saved_seed)
+            if saved_seed < 0:
+                saved_seed = None
+        if saved_seed is not None and saved_seed != self.seed:
+            import warnings
+
+            warnings.warn(
+                f"DataLoader.load_state_dict: checkpoint seed {saved_seed} "
+                f"differs from configured seed {self.seed}; adopting the "
+                "checkpoint's so the replayed order matches the saved run"
+            )
+            self.seed = int(saved_seed)
+            if isinstance(self.batch_sampler, BatchSampler):
+                cur = getattr(self.batch_sampler, "sampler", None)
+                if isinstance(cur, _SeededRandomSampler):
+                    cur.seed = int(saved_seed)
+                elif self.shuffle and isinstance(cur, RandomSampler):
+                    # loader was built WITHOUT a seed (global-RNG shuffle):
+                    # adopting the checkpoint's seed must also install the
+                    # seeded sampler, or the promise above is a lie — the
+                    # permutation would still come from ambient RNG state
+                    self.batch_sampler.sampler = _SeededRandomSampler(
+                        self.dataset, int(saved_seed), lambda: self._epoch
+                    )
+
+    # checkpoint-tree participation: distributed/checkpoint.py restores
+    # state_dict-bearing objects through set_state_dict
+    set_state_dict = load_state_dict
+
+    def _index_iter(self):
+        """Index-batch stream for this epoch, with the resume fast-forward
+        applied at the INDEX level — skipped batches are never loaded."""
+        it = iter(self.batch_sampler)
+        skip, self._resume_skip = self._resume_skip, 0
+        for _ in range(skip):
+            next(it, None)
+        self._batch_idx = skip
+        return it, skip
+
+    def _stateful_iter(self):
+        """This epoch's position-tracking iterator WITHOUT the device-
+        prefetch wrap — what DevicePrefetcher.load_state_dict rebinds to (a
+        nested prefetcher would start staging batches before it could be
+        adopted, silently dropping them)."""
         if isinstance(self.dataset, IterableDataset):
             it = _IterableIter(self)
+            skip = self._batch_idx
         elif self.num_workers > 0 and self.use_multiprocess:
             import multiprocessing as mp
 
@@ -736,11 +946,17 @@ class DataLoader:
                 it = _MultiprocessIter(self)
             else:
                 it = _DataLoaderIter(self)
+            skip = self._batch_idx
         else:
             it = _DataLoaderIter(self)
+            skip = self._batch_idx
+        return _StatefulIter(self, it, skip)
+
+    def __iter__(self):
+        stateful = self._stateful_iter()
         if self.device_prefetch > 0:
-            return DevicePrefetcher(it, buffer_size=self.device_prefetch)
-        return it
+            return DevicePrefetcher(stateful, buffer_size=self.device_prefetch)
+        return stateful
 
     def __len__(self):
         if self.batch_sampler is None:

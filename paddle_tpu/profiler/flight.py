@@ -38,7 +38,8 @@ from typing import Optional
 
 __all__ = [
     "record", "dump", "last_dump", "recent_spans", "capacity", "enabled",
-    "install_excepthook", "on_crash", "clear",
+    "install_excepthook", "on_crash", "clear", "add_context_provider",
+    "remove_context_provider",
 ]
 
 _DISABLED = os.environ.get("PADDLE_TPU_FLIGHT_DISABLE", "").lower() in (
@@ -83,6 +84,33 @@ def _dump_dir() -> str:
     )
 
 
+# Subsystems register a provider so EVERY dump — whatever its trigger —
+# carries their context: the distributed watchdog adds the cross-rank
+# progress table + suspect verdict this way, so a NaN trip on rank 3 still
+# shows where ranks 0-2 were. Providers run inside dump() and must be cheap;
+# a provider that raises contributes an error marker instead of masking the
+# dump.
+_context_providers: dict = {}
+
+
+def add_context_provider(name: str, fn) -> None:
+    _context_providers[name] = fn
+
+
+def remove_context_provider(name: str) -> None:
+    _context_providers.pop(name, None)
+
+
+def _provider_context() -> dict:
+    out = {}
+    for name, fn in list(_context_providers.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:
+            out[name] = {"error": repr(e)}
+    return out
+
+
 def _pending_graph_summary() -> dict:
     try:
         from ..core import lazy
@@ -116,6 +144,7 @@ def dump(reason: str, extra: Optional[dict] = None, path: Optional[str] = None) 
         **_export.metrics_snapshot(),
         "pending_graph": _pending_graph_summary(),
         "fault_inject": fault_state,
+        "context": _provider_context(),
         "extra": dict(extra or {}),
     }
     try:

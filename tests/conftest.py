@@ -54,6 +54,23 @@ def pytest_configure(config):
         "perf/parity); auto-skipped when the environment provides fewer "
         "devices — the same skip discipline as the multiprocess-env tests",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: multi-process chaos-injection recovery tests (kill/hang a "
+        "rank mid-run, assert bounded-time coordinated recovery); each "
+        "worker is a fresh interpreter importing jax, so the suite needs a "
+        "real multi-process budget — auto-skipped on the CPU tier unless "
+        "PADDLE_TPU_CHAOS=1 opts in",
+    )
+
+
+def _chaos_world_available() -> bool:
+    """The chaos suite spawns whole fresh-interpreter worlds (jax import per
+    worker). The JAX_PLATFORMS=cpu CI tier lacks that process budget, so
+    chaos runs only on explicit opt-in."""
+    if os.environ.get("PADDLE_TPU_CHAOS") == "1":
+        return True
+    return os.environ.get("JAX_PLATFORMS", "cpu") != "cpu"
 
 
 def pytest_collection_modifyitems(config, items):
@@ -64,4 +81,10 @@ def pytest_collection_modifyitems(config, items):
         if item.get_closest_marker("multichip") is not None and n_devices < 8:
             item.add_marker(pytest.mark.skip(
                 reason=f"multichip tests need 8 devices, have {n_devices}"
+            ))
+        if item.get_closest_marker("chaos") is not None and not _chaos_world_available():
+            item.add_marker(pytest.mark.skip(
+                reason="chaos tests spawn fresh multi-process worlds; the "
+                "JAX_PLATFORMS=cpu tier lacks the process budget "
+                "(set PADDLE_TPU_CHAOS=1 to opt in)"
             ))

@@ -1,0 +1,287 @@
+"""Multi-process chaos recovery: kill (and separately wedge) a rank
+mid-collective under injection and assert the full recovery contract:
+
+1. the failure is DETECTED within FLAGS_collective_timeout_s (plus dump
+   slack) — no survivor blocks forever;
+2. every surviving rank writes a flight-recorder post-mortem naming the
+   suspect rank;
+3. the launcher loop relaunches and the world resumes from the last
+   COORDINATED checkpoint;
+4. the resumed run's per-step losses are BIT-FOR-BIT equal to an
+   uninterrupted run — sample order (DataLoader state), RNG stream
+   (program_rng), and weights (coordinated commit) all replayed exactly.
+
+Workers are fresh interpreters (subprocess) coordinating over a FileStore +
+progress dir — the same substrate ``spawn``/``launch`` provision — so the
+suite is heavy; the ``chaos`` marker auto-skips it on the CPU CI tier
+(opt in with PADDLE_TPU_CHAOS=1).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+pytestmark = [pytest.mark.faults, pytest.mark.chaos]
+
+WORLD = 2
+TOTAL_STEPS = 9
+CKPT_INTERVAL = 3
+FAIL_STEP = 5
+TIMEOUT_S = 4.0
+
+_WORKER = r"""
+import json, os, sys
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu.core import random as prandom
+from paddle_tpu.distributed import watchdog
+from paddle_tpu.distributed.checkpoint import CoordinatedCheckpoint
+from paddle_tpu.distributed.coord import wait_for
+from paddle_tpu.framework import flags as fw_flags
+from paddle_tpu.io import DataLoader, Dataset
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+run_dir = os.environ["CHAOS_RUN_DIR"]
+total_steps = int(os.environ["CHAOS_TOTAL_STEPS"])
+ckpt_interval = int(os.environ["CHAOS_CKPT_INTERVAL"])
+incarnation = os.environ["CHAOS_INCARNATION"]
+
+fw_flags.set_flags({"FLAGS_collective_timeout_s": float(os.environ["CHAOS_TIMEOUT_S"])})
+watchdog.configure()  # rank/world/store/progress all from the launcher env
+store = watchdog._cfg["store"]
+assert store is not None, "chaos worker needs PADDLE_TPU_STORE_DIR"
+
+
+class ArangeDS(Dataset):
+    def __getitem__(self, i):
+        return np.float32([i, i * 0.5, -i, 1.0])
+
+    def __len__(self):
+        return 64
+
+
+paddle_tpu.seed(1234)
+loader = DataLoader(ArangeDS(), batch_size=4, shuffle=True, seed=99)
+w = paddle_tpu.to_tensor(np.zeros(4, np.float32))
+state = {"w": w, "rng": paddle_tpu.program_rng, "loader": loader}
+
+cc = CoordinatedCheckpoint(
+    os.path.join(run_dir, "ckpt"), world_size=world, rank=rank, store=store,
+    interval_steps=ckpt_interval, commit_timeout_s=10.0,
+)
+start = cc.resume(state) + 1
+
+loss_log = open(os.path.join(run_dir, f"losses_rank{rank}_{incarnation}.jsonl"), "w")
+it = iter(loader)
+
+for step in range(start, total_steps):
+    # the chaos points (rank.kill / rank.hang / rank.slow) fire here
+    watchdog.publish(step=step, phase="train_step", force=True)
+    try:
+        batch = next(it)
+    except StopIteration:
+        it = iter(loader)
+        batch = next(it)
+    x = jnp.asarray(batch._data)
+    noise = jax.random.normal(prandom.next_key(), (4,), jnp.float32) * 0.01
+    wv = jnp.asarray(w._data)
+    pred = x @ (wv + noise)
+    loss = jnp.mean((pred - jnp.sum(x, axis=1)) ** 2)
+    grad = jax.grad(lambda ww: jnp.mean((x @ (ww + noise) - jnp.sum(x, axis=1)) ** 2))(wv)
+    w._set_data(wv - 0.01 * grad)
+    loss_log.write(json.dumps({
+        "step": step,
+        "loss_hex": float(loss).hex(),
+        "w_hex": [float(v).hex() for v in np.asarray(w._data)],
+    }) + "\n")
+    loss_log.flush()
+
+    # the per-step collective: every rank must arrive; a dead/wedged peer
+    # leaves the survivors inside the guard until the watchdog deadline
+    bar = f"chaos/bar/{incarnation}/{step}"
+    store.add(bar, 1)
+    with watchdog.guard(f"barrier:step{step}"):
+        wait_for(lambda: int(store.get(bar) or 0) >= world,
+                 f"barrier step {step}", 0.0, interval_s=0.01)
+
+    cc.maybe_save(step, state)
+
+loss_log.close()
+with open(os.path.join(run_dir, f"done_rank{rank}_{incarnation}"), "w") as f:
+    f.write("ok")
+sys.exit(0)
+"""
+
+
+def _launch_world(run_dir, incarnation, inject_spec=None, timeout_s=TIMEOUT_S):
+    script = run_dir / "worker.py"
+    script.write_text(_WORKER)
+    flight_dir = run_dir / "flight"
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        repo_root = str(Path(__file__).resolve().parent.parent)
+        env.update({
+            "PYTHONPATH": repo_root + (
+                os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+            ),
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(WORLD),
+            "PADDLE_TPU_STORE_DIR": str(run_dir / "store"),
+            "PADDLE_TPU_PROGRESS_DIR": str(run_dir / "progress"),
+            "PADDLE_TPU_FLIGHT_DIR": str(flight_dir),
+            "CHAOS_RUN_DIR": str(run_dir),
+            "CHAOS_TOTAL_STEPS": str(TOTAL_STEPS),
+            "CHAOS_CKPT_INTERVAL": str(CKPT_INTERVAL),
+            "CHAOS_INCARNATION": incarnation,
+            "CHAOS_TIMEOUT_S": str(timeout_s),
+        })
+        env.pop("PADDLE_FAULT_INJECT", None)
+        if inject_spec:
+            env["PADDLE_FAULT_INJECT"] = inject_spec
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        ))
+    return procs
+
+
+def _wait_world(procs, deadline_s=180.0):
+    """Poll until every proc exits (or deadline); returns (codes, leftovers).
+    A wedged rank (rank.hang) never exits — the launcher reaps it once the
+    survivors have rendered their verdict, exactly like spawn's join."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        codes = [p.poll() for p in procs]
+        exited = [c for c in codes if c is not None]
+        if len(exited) == len(procs):
+            return codes, []
+        # all SURVIVORS done, only the wedged injected rank still alive
+        if len(exited) == len(procs) - 1 and any(c == 75 for c in exited):
+            time.sleep(1.0)
+            leftovers = [p for p in procs if p.poll() is None]
+            if leftovers:
+                for p in leftovers:
+                    p.terminate()
+                for p in leftovers:
+                    p.wait(10)
+                return [p.poll() for p in procs], leftovers
+        time.sleep(0.2)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    raise AssertionError(
+        "chaos world did not settle within the recovery budget; codes="
+        f"{[p.poll() for p in procs]}, logs="
+        f"{[p.stdout.read().decode()[-800:] for p in procs]}"
+    )
+
+
+def _read_losses(run_dir, rank, incarnations):
+    """step -> record, later incarnations winning; overlapping replayed
+    steps must agree bit-for-bit (asserted) — the sample-exact pin."""
+    merged = {}
+    for inc in incarnations:
+        path = run_dir / f"losses_rank{rank}_{inc}.jsonl"
+        if not path.exists():
+            continue
+        for line in path.read_text().splitlines():
+            rec = json.loads(line)
+            if rec["step"] in merged:
+                assert merged[rec["step"]] == rec, (
+                    f"replayed step {rec['step']} diverged between "
+                    f"incarnations on rank {rank}: {merged[rec['step']]} "
+                    f"vs {rec}"
+                )
+            merged[rec["step"]] = rec
+    return merged
+
+
+def _flight_dumps(run_dir):
+    out = []
+    fdir = run_dir / "flight"
+    if fdir.exists():
+        for p in sorted(fdir.glob("flight_*.json")):
+            out.append(json.loads(p.read_text()))
+    return out
+
+
+@pytest.mark.parametrize("failure", ["kill", "hang"])
+def test_chaos_recovery_bit_for_bit(tmp_path, failure):
+    # ---- reference: uninterrupted run ----------------------------------
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    codes, _ = _wait_world(_launch_world(ref_dir, "0"))
+    assert codes == [0] * WORLD
+    ref = {r: _read_losses(ref_dir, r, ["0"]) for r in range(WORLD)}
+    assert all(len(ref[r]) == TOTAL_STEPS for r in range(WORLD))
+
+    # ---- chaos run: rank 1 dies/wedges at FAIL_STEP mid-collective -----
+    run_dir = tmp_path / "chaos"
+    run_dir.mkdir()
+    spec = (f"rank.kill:rank=1,step={FAIL_STEP}" if failure == "kill"
+            else f"rank.hang:rank=1,step={FAIL_STEP}")
+    t_start = time.monotonic()
+    procs = _launch_world(run_dir, "0", inject_spec=spec)
+    codes, reaped = _wait_world(procs)
+    detect_elapsed = time.monotonic() - t_start
+
+    if failure == "kill":
+        assert codes[1] == 137, codes  # the injected hard kill
+    else:
+        assert reaped, "wedged rank should have needed reaping"
+    # every SURVIVOR detected the stall and exited resumably (75)
+    assert codes[0] == 75, codes
+    # bounded-time detection: worker startup (jax import) + steps + the
+    # watchdog deadline + dump slack — generously bounded, never a hang
+    assert detect_elapsed < 150.0
+
+    dumps = _flight_dumps(run_dir)
+    timeout_dumps = [d for d in dumps if d["reason"] == "collective_timeout"]
+    assert timeout_dumps, "surviving rank wrote no post-mortem"
+    for d in timeout_dumps:
+        assert d["extra"]["suspect_rank"] == 1
+        assert "barrier:step" in d["extra"]["what"]
+        assert d["context"]["watchdog"]["suspect_rank"] == 1
+
+    # ---- relaunch (the launcher's resume leg), no injection ------------
+    codes, _ = _wait_world(_launch_world(run_dir, "1"))
+    assert codes == [0] * WORLD
+    for r in range(WORLD):
+        assert (run_dir / f"done_rank{r}_1").exists()
+
+    # ---- bit-for-bit: interrupted+resumed == uninterrupted -------------
+    for r in range(WORLD):
+        got = _read_losses(run_dir, r, ["0", "1"])
+        assert set(got) == set(ref[r]), (
+            f"rank {r}: steps differ: {sorted(set(ref[r]) ^ set(got))}"
+        )
+        for step in sorted(ref[r]):
+            assert got[step] == ref[r][step], (
+                f"rank {r} step {step}: resumed run diverged: "
+                f"{got[step]} vs {ref[r][step]}"
+            )
+
+
+def test_chaos_slow_rank_only_delays(tmp_path):
+    """rank.slow is a straggler, not a failure: the world completes with no
+    trips and no dumps — the watchdog tolerates slowness inside deadline."""
+    run_dir = tmp_path / "slow"
+    run_dir.mkdir()
+    codes, _ = _wait_world(_launch_world(
+        run_dir, "0", inject_spec="rank.slow:rank=1,ms=300,times=2",
+        timeout_s=30.0))
+    assert codes == [0] * WORLD
+    assert not [d for d in _flight_dumps(run_dir)
+                if d["reason"] == "collective_timeout"]

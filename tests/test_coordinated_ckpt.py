@@ -1,0 +1,285 @@
+"""Coordinated multi-rank checkpoint commit: no crash interleaving may leave
+a resume-visible mixed-step checkpoint.
+
+Rank concurrency is simulated with threads sharing a FileStore (each rank has
+its own CoordinatedCheckpoint instance); the injection sweep walks the crash
+point across serialize → write → ack → commit on each rank and asserts the
+two protocol invariants after EVERY interleaving:
+
+1. resume lands ALL ranks on the same step (never mixed);
+2. that step is the newest one EVERY rank committed.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointError,
+    CoordinatedCheckpoint,
+    save_state_dict,
+)
+from paddle_tpu.distributed.coord import FileStore
+from paddle_tpu.fault import inject
+
+pytestmark = pytest.mark.faults
+
+WORLD = 2
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    inject.disarm()
+    yield
+    inject.disarm()
+
+
+def _state(rank, step):
+    # distinct per (rank, step) so a mixed restore is detectable by value
+    return {"w": paddle_tpu.to_tensor(
+        np.full((4,), rank * 100.0 + step, np.float32))}
+
+
+def _world(tmp_path, **kw):
+    store = FileStore(str(tmp_path / "store"))
+    return [
+        CoordinatedCheckpoint(
+            str(tmp_path / "ckpt"), world_size=WORLD, rank=r, store=store,
+            commit_timeout_s=kw.pop("commit_timeout_s", 5.0), **dict(kw),
+        )
+        for r in range(WORLD)
+    ]
+
+
+def _save_all(ranks, step, timeout=30.0):
+    """Run every rank's save_now concurrently; returns per-rank results."""
+    results = [None] * len(ranks)
+
+    def run(r):
+        results[r] = ranks[r].save_now(step, _state(r, step))
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(len(ranks))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout)
+    return results
+
+
+def _resume_all(ranks):
+    """Each rank resolves + loads independently; returns (steps, values)."""
+    steps, values = [], []
+    for r, cc in enumerate(ranks):
+        sd = _state(r, -1)
+        steps.append(cc.resume(sd))
+        values.append(float(np.asarray(sd["w"]._data)[0]))
+    return steps, values
+
+
+class TestHappyPath:
+    def test_two_rank_commit_and_resume(self, tmp_path):
+        ranks = _world(tmp_path)
+        assert _save_all(ranks, 10) == [True, True]
+        marker = os.path.join(str(tmp_path / "ckpt"), "step_10",
+                              CoordinatedCheckpoint.COMMIT_MARKER)
+        rec = json.load(open(marker))
+        assert rec["committed"] and rec["world_size"] == WORLD
+        steps, values = _resume_all(ranks)
+        assert steps == [10, 10]
+        assert values == [10.0, 110.0]  # each rank got ITS OWN shard back
+
+    def test_maybe_save_interval(self, tmp_path):
+        ranks = _world(tmp_path, interval_steps=5)
+        assert ranks[0].maybe_save(3, _state(0, 3)) is False
+        assert not os.path.isdir(str(tmp_path / "ckpt" / "step_3"))
+
+    def test_preemption_guard_signature_compat(self, tmp_path):
+        # PreemptionGuard.drain calls save_now(step, sd, sync=True)
+        store = FileStore(str(tmp_path / "store"))
+        cc = CoordinatedCheckpoint(str(tmp_path / "ckpt"), world_size=1,
+                                   rank=0, store=store, commit_timeout_s=2.0)
+        assert cc.save_now(4, _state(0, 4), sync=True) is True
+
+
+class TestCrashSweep:
+    """The acceptance pin: sweep the crash point across every protocol phase
+    on every rank; no interleaving may produce a mixed-step resume."""
+
+    @pytest.mark.parametrize("point", ["ckpt.serialize", "ckpt.write",
+                                       "ckpt.ack", "ckpt.commit"])
+    @pytest.mark.parametrize("crash_rank", [0, 1])
+    def test_crash_point_never_mixes_steps(self, tmp_path, point, crash_rank):
+        ranks = _world(tmp_path, commit_timeout_s=1.0)
+        assert _save_all(ranks, 1) == [True, True]  # recovery point
+
+        inject.arm({point: {"rank": crash_rank}} if point != "ckpt.write"
+                   else {point: {}})  # ckpt.write has no rank ctx: fires once
+        try:
+            results = _save_all(ranks, 2)
+        finally:
+            inject.disarm()
+
+        steps, values = _resume_all(ranks)
+        assert steps[0] == steps[1], f"mixed-step resume: {steps}"
+        landed = steps[0]
+        assert landed in (1, 2)
+        # value consistency: each rank's shard is from the SAME save
+        assert values == [0 * 100.0 + landed, 1 * 100.0 + landed]
+        if landed == 2:
+            # only possible when every rank's shard was durable + acked —
+            # i.e. the "crash" hit after the commit became inevitable
+            assert ranks[0]._step_fully_committed(2)
+        else:
+            # the failed save must not have published a commit marker
+            assert not os.path.exists(
+                os.path.join(str(tmp_path / "ckpt"), "step_2",
+                             CoordinatedCheckpoint.COMMIT_MARKER))
+            assert results[crash_rank] is False
+
+    def test_rank0_crash_before_marker_leaves_world_uncommitted(self, tmp_path):
+        # the tightest window: every rank acked, marker not yet durable
+        ranks = _world(tmp_path, commit_timeout_s=1.0)
+        assert _save_all(ranks, 1) == [True, True]
+        inject.arm({"ckpt.commit": {"rank": 0}})
+        try:
+            results = _save_all(ranks, 2)
+        finally:
+            inject.disarm()
+        assert results[0] is False
+        # rank 1 times out waiting for the marker — uncommitted for it too
+        assert results[1] is False
+        steps, _ = _resume_all(ranks)
+        assert steps == [1, 1]
+
+
+class TestManifestAgreement:
+    def test_mixed_step_directory_rejected_naming_both_steps(self, tmp_path):
+        ranks = _world(tmp_path)
+        sdir = tmp_path / "ckpt" / "step_5"
+        sdir.mkdir(parents=True)
+        # rank manifests written at DIFFERENT steps — corrupt-by-construction
+        save_state_dict(_state(0, 5), str(sdir / "rank_0"), step=5)
+        save_state_dict(_state(1, 7), str(sdir / "rank_1"), step=7)
+        with pytest.raises(CheckpointError) as ei:
+            ranks[0].check_manifest_agreement(5)
+        msg = str(ei.value)
+        assert "step 5" in msg and "step 7" in msg
+        # resume refuses loudly rather than walking past corruption
+        with pytest.raises(CheckpointError):
+            ranks[0].resume(_state(0, -1))
+
+    def test_walkback_lands_on_newest_step_every_rank_committed(self, tmp_path):
+        ranks = _world(tmp_path)
+        assert _save_all(ranks, 100) == [True, True]
+        # step 200: rank 0 wrote its shard, rank 1 died first — no marker
+        sdir = tmp_path / "ckpt" / "step_200"
+        sdir.mkdir(parents=True)
+        save_state_dict(_state(0, 200), str(sdir / "rank_0"), step=200)
+        steps, values = _resume_all(ranks)
+        assert steps == [100, 100]
+        assert values == [100.0, 200.0]
+
+    def test_marker_without_all_manifests_not_committed(self, tmp_path):
+        ranks = _world(tmp_path)
+        assert _save_all(ranks, 100) == [True, True]
+        sdir = tmp_path / "ckpt" / "step_300"
+        sdir.mkdir(parents=True)
+        save_state_dict(_state(0, 300), str(sdir / "rank_0"), step=300)
+        # a forged/partial marker: rank 1's manifest is missing
+        ranks[0]._write_marker(300)
+        assert not ranks[0]._step_fully_committed(300)
+        steps, _ = _resume_all(ranks)
+        assert steps == [100, 100]
+
+    def test_store_resume_agreement_rejects_disagreement(self, tmp_path):
+        ranks = _world(tmp_path, commit_timeout_s=1.0)
+        # rank 1 claims it resolved step 9; rank 0 resolved step 5
+        ranks[0].store.set("ckpt/resume/1", "9")
+        with pytest.raises(CheckpointError, match="disagree"):
+            ranks[0]._agree_on_resume_step(5)
+
+    def test_agreed_step_load_failure_raises_not_walks_back(self, tmp_path):
+        # once the world AGREED on a step, a rank whose shard fails to load
+        # must raise — silently walking back to an older step while peers
+        # load the agreed one is exactly the mixed-step state the protocol
+        # forbids
+        ranks = _world(tmp_path, commit_timeout_s=1.0)
+        assert _save_all(ranks, 1) == [True, True]
+        assert _save_all(ranks, 2) == [True, True]
+        # bitrot rank 1's step-2 shard AFTER commit: manifest still says
+        # committed, checksum verify fails on load
+        man_path = str(tmp_path / "ckpt" / "step_2" / "rank_1.manifest.json")
+        man = json.load(open(man_path))
+        key = next(iter(man["tree"]))
+        man["tree"][key]["crc32"] = (man["tree"][key]["crc32"] ^ 1)
+        json.dump(man, open(man_path, "w"))
+        # rank 0 resolves step 2 (agreement advisory — peer vote absent —
+        # but its vote stays on the store)...
+        assert ranks[0].resume(_state(0, -1)) == 2
+        # ...so rank 1's agreement is FULL and unanimous at step 2; its
+        # corrupt shard must abort the resume, not fall back to step 1
+        with pytest.raises(CheckpointError, match="agreed to resume"):
+            ranks[1].resume(_state(1, -1))
+
+
+class TestStaleAckLitter:
+    """A crashed save attempt leaves acks/commit litter on the store; a
+    relaunched job replaying to the same step must not count it."""
+
+    def test_commit_barrier_reset_clears_litter(self, tmp_path):
+        from paddle_tpu.distributed.coord import CommitBarrier
+
+        st = FileStore(str(tmp_path))
+        b = CommitBarrier(st, 2, 0)
+        b.ack("s7")
+        st.set("commit/s7/commit", "{}")
+        b.reset("s7")
+        assert b.acks("s7") == 0 and not b.committed("s7")
+
+    def test_stale_acks_cannot_commit_a_retried_save_early(self, tmp_path):
+        ranks = _world(tmp_path, commit_timeout_s=1.0)
+        assert _save_all(ranks, 1) == [True, True]
+        # dead attempt at step 2 left a FULL ack count behind
+        ranks[0].store.set("ckpt/2/acks", str(WORLD))
+        # rank 0 alone retries the save: without the entry reset it would
+        # see world_size stale acks and commit a step rank 1 never wrote
+        assert ranks[0].save_now(2, _state(0, 2)) is False
+        assert not os.path.exists(
+            os.path.join(str(tmp_path / "ckpt"), "step_2",
+                         CoordinatedCheckpoint.COMMIT_MARKER))
+        steps, _ = _resume_all(ranks)
+        assert steps == [1, 1]
+
+    def test_retried_save_over_litter_commits_normally(self, tmp_path):
+        ranks = _world(tmp_path)
+        ranks[0].store.set("ckpt/2/acks", str(WORLD))  # stale litter
+        assert _save_all(ranks, 2) == [True, True]
+        steps, values = _resume_all(ranks)
+        assert steps == [2, 2]
+        assert values == [2.0, 102.0]
+
+
+class TestGC:
+    def test_gc_keeps_newest_committed(self, tmp_path):
+        ranks = _world(tmp_path, keep_last=1)
+        assert _save_all(ranks, 1) == [True, True]
+        assert _save_all(ranks, 2) == [True, True]
+        # uncommitted litter from a crashed later save
+        sdir = tmp_path / "ckpt" / "step_3"
+        sdir.mkdir(parents=True)
+        save_state_dict(_state(0, 3), str(sdir / "rank_0"), step=3)
+        ranks[0]._gc()
+        root = tmp_path / "ckpt"
+        assert not (root / "step_1").exists()   # GC'd
+        assert (root / "step_2").exists()       # newest committed: protected
+        assert (root / "step_3").exists()       # within keep_last window
+        steps, _ = _resume_all(ranks)
+        assert steps == [2, 2]
+
+    def test_resume_empty_dir_returns_minus_one(self, tmp_path):
+        ranks = _world(tmp_path)
+        steps, _ = _resume_all(ranks)
+        assert steps == [-1, -1]

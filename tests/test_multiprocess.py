@@ -70,7 +70,23 @@ def _spawn(rank, port, store_port, extra_env=None):
     )
 
 
+# This jaxlib's CPU client rejects cross-process collectives outright
+# ("INVALID_ARGUMENT: Multiprocess computations aren't implemented on the
+# CPU backend") — the rendezvous works, the psum doesn't. An environment
+# limit of the CPU test tier, not a distributed-runtime regression: the
+# tests stay as non-strict xfails so a jaxlib that CAN run them shows up
+# as XPASS instead of being silently skipped.
+_CPU_MULTIPROC_XFAIL = pytest.mark.xfail(
+    os.environ.get("JAX_PLATFORMS", "cpu") == "cpu",
+    reason="environment limit: jaxlib CPU backend does not implement "
+    "multiprocess computations (XlaRuntimeError INVALID_ARGUMENT in the "
+    "worker's collective)",
+    strict=False,
+)
+
+
 class TestMultiProcessBootstrap:
+    @_CPU_MULTIPROC_XFAIL
     def test_two_process_rendezvous_and_collective(self):
         port, store_port = 9931, 9932
         p0 = _spawn(0, port, store_port)
@@ -186,6 +202,7 @@ def _spawn_worker(out_dir):
 
 
 class TestSpawn:
+    @_CPU_MULTIPROC_XFAIL
     def test_spawn_two_process_collective(self, tmp_path):
         from paddle_tpu.distributed.spawn import spawn
 

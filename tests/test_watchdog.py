@@ -1,0 +1,437 @@
+"""Distributed watchdog + coordination substrate + sample-exact resume.
+
+Single-process tests for the supervision layer (multi-rank interleavings are
+simulated with threads over a FileStore; the REAL multi-process worlds live
+in test_chaos_recovery.py under the ``chaos`` marker):
+
+* FileStore / CommitBarrier — the coordination substrate;
+* watchdog progress table, suspect attribution, deadline guards, and the
+  tier-1 inert tripwire (FLAGS_collective_timeout_s=0 → zero threads, zero
+  store traffic, no syncs added to the step path);
+* rank.slow / rank.hang / rank.kill / collective.drop chaos plumbing
+  (in-process only where safe: rank.slow delay, should_fire filters);
+* DataLoader / DevicePrefetcher state_dict — sample-exact resume — and the
+  program RNG checkpoint round-trip.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.distributed import coord, watchdog
+from paddle_tpu.distributed.coord import CommitBarrier, DeadlineExceeded, FileStore
+from paddle_tpu.fault import inject
+from paddle_tpu.framework import flags as fw_flags
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.profiler import flight
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_watchdog():
+    watchdog.reset()
+    fw_flags.set_flags({"FLAGS_collective_timeout_s": 0.0})
+    inject.disarm()
+    yield
+    watchdog.set_abort_fn(None)
+    watchdog.reset()
+    fw_flags.set_flags({"FLAGS_collective_timeout_s": 0.0})
+    inject.disarm()
+
+
+# ---------------------------------------------------------------- FileStore
+class TestFileStore:
+    def test_set_get_roundtrip(self, tmp_path):
+        st = FileStore(str(tmp_path))
+        st.set("a/b", "hello")
+        assert st.get("a/b") == b"hello"
+        assert st.get("missing") is None
+        st.delete_key("a/b")
+        assert st.get("a/b") is None
+
+    def test_keys_escape_slashes(self, tmp_path):
+        st = FileStore(str(tmp_path))
+        st.set("wd/progress/3", "x")
+        st.set("plain", "y")
+        assert sorted(st.keys()) == ["plain", "wd/progress/3"]
+
+    def test_add_serializes_concurrent_increments(self, tmp_path):
+        st = FileStore(str(tmp_path))
+        n_threads, per_thread = 8, 25
+        errs = []
+
+        def bump():
+            try:
+                for _ in range(per_thread):
+                    st.add("ctr", 1)
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(e)
+
+        ts = [threading.Thread(target=bump) for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        assert int(st.get("ctr")) == n_threads * per_thread
+
+    def test_wait_for_deadline(self):
+        with pytest.raises(DeadlineExceeded) as ei:
+            coord.wait_for(lambda: False, "nothing", 0.15, interval_s=0.02)
+        assert "nothing" in str(ei.value)
+        # timeout<=0 means no deadline: poll until truthy
+        hits = []
+        coord.wait_for(lambda: hits.append(1) or len(hits) > 2, "counts", 0.0,
+                       interval_s=0.001)
+
+
+# ------------------------------------------------------------ CommitBarrier
+class TestCommitBarrier:
+    def test_two_phase_commit_world2(self, tmp_path):
+        st = FileStore(str(tmp_path))
+        b0 = CommitBarrier(st, 2, 0)
+        b1 = CommitBarrier(st, 2, 1)
+        out = {}
+
+        def rank1():
+            b1.ack("s10")
+            out[1] = b1.commit("s10", timeout_s=5.0)
+
+        t = threading.Thread(target=rank1)
+        t.start()
+        b0.ack("s10")
+        out[0] = b0.commit("s10", timeout_s=5.0)
+        t.join()
+        assert out[0]["tag"] == out[1]["tag"] == "s10"
+        assert b0.committed("s10") and b1.committed("s10")
+
+    def test_missing_rank_leaves_uncommitted(self, tmp_path):
+        st = FileStore(str(tmp_path))
+        b0 = CommitBarrier(st, 2, 0)
+        b0.ack("s20")  # rank 1 never arrives
+        with pytest.raises(DeadlineExceeded):
+            b0.commit("s20", timeout_s=0.2)
+        assert not b0.committed("s20")
+
+    def test_distinct_tags_independent(self, tmp_path):
+        st = FileStore(str(tmp_path))
+        b = CommitBarrier(st, 1, 0)
+        b.ack("old")  # litter from a crashed attempt
+        b.ack("new")
+        b.commit("new", timeout_s=1.0)
+        assert b.committed("new") and not b.committed("old")
+
+
+# ----------------------------------------------------------------- watchdog
+class TestWatchdogProgress:
+    def test_publish_writes_progress_file(self, tmp_path):
+        watchdog.configure(rank=0, world_size=2, store=None,
+                           progress_dir=str(tmp_path))
+        watchdog.publish(step=7, phase="train_step", force=True)
+        rec = json.loads((tmp_path / "rank_0.json").read_text())
+        assert rec["step"] == 7 and rec["phase"] == "train_step"
+        assert watchdog.local_progress()["step"] == 7
+
+    def test_progress_table_merges_store_over_files(self, tmp_path):
+        store = FileStore(str(tmp_path / "store"))
+        pdir = tmp_path / "progress"
+        pdir.mkdir()
+        (pdir / "rank_1.json").write_text(json.dumps({"rank": 1, "step": 3}))
+        store.set("wd/progress/1", json.dumps({"rank": 1, "step": 9}))
+        watchdog.configure(rank=0, world_size=2, store=store,
+                           progress_dir=str(pdir))
+        table = watchdog.progress_table()
+        assert table[1]["step"] == 9  # store record wins (fresher path)
+
+    def test_suspect_names_silent_rank(self, tmp_path):
+        watchdog.configure(rank=0, world_size=3, store=None,
+                           progress_dir=str(tmp_path))
+        watchdog.publish(step=5, force=True)
+        (tmp_path / "rank_1.json").write_text(
+            json.dumps({"rank": 1, "step": 5, "phase": "train_step",
+                        "ts": time.time()}))
+        sus, why = watchdog.suspect()
+        assert sus == 2 and "no progress record" in why
+
+    def test_suspect_names_straggler(self, tmp_path):
+        watchdog.configure(rank=0, world_size=3, store=None,
+                           progress_dir=str(tmp_path))
+        now = time.time()
+        for r, step in ((0, 10), (1, 10), (2, 4)):
+            (tmp_path / f"rank_{r}.json").write_text(
+                json.dumps({"rank": r, "step": step, "phase": "train_step",
+                            "ts": now}))
+        sus, why = watchdog.suspect()
+        assert sus == 2 and "step 4" in why
+
+    def test_suspect_never_names_the_reporting_rank(self, tmp_path):
+        # early-startup hang: NO rank has published yet. The reporter is
+        # alive enough to be asking — it must blame a peer, not itself
+        watchdog.configure(rank=0, world_size=3, store=None,
+                           progress_dir=str(tmp_path))
+        sus, why = watchdog.suspect()
+        assert sus == 1 and "no progress record" in why
+
+    def test_publish_without_session_is_noop(self):
+        assert not watchdog.configured()
+        watchdog.publish(step=1)  # must not raise, must not create state
+        assert watchdog.local_progress() == {}
+
+
+class TestWatchdogGuard:
+    def test_guard_trips_and_names_suspect(self, tmp_path):
+        codes = []
+        watchdog.set_abort_fn(codes.append)
+        watchdog.configure(rank=0, world_size=2, store=None,
+                           progress_dir=str(tmp_path))
+        watchdog.publish(step=9, phase="train_step", force=True)
+        (tmp_path / "rank_1.json").write_text(
+            json.dumps({"rank": 1, "step": 2, "phase": "train_step",
+                        "ts": time.time() - 30}))
+        fw_flags.set_flags({"FLAGS_collective_timeout_s": 0.25})
+        with watchdog.guard("allreduce:test"):
+            deadline = time.time() + 5
+            while not codes and time.time() < deadline:
+                time.sleep(0.02)  # the wedged collective that never returns
+        assert codes == [75]
+        path = flight.last_dump()
+        assert path is not None
+        doc = json.loads(open(path).read())
+        assert doc["reason"] == "collective_timeout"
+        assert doc["extra"]["suspect_rank"] == 1
+        assert doc["extra"]["what"] == "allreduce:test"
+        # the registered context provider puts the cross-rank table in EVERY
+        # dump, with the same verdict
+        assert doc["context"]["watchdog"]["suspect_rank"] == 1
+
+    def test_guard_disarms_on_normal_exit(self):
+        codes = []
+        watchdog.set_abort_fn(codes.append)
+        watchdog.configure(rank=0, world_size=1, store=None, progress_dir=None)
+        fw_flags.set_flags({"FLAGS_collective_timeout_s": 0.2})
+        with watchdog.guard("fast-op"):
+            pass  # returns well before the deadline
+        time.sleep(0.35)
+        assert codes == []
+
+    def test_guarded_wait_trips(self, tmp_path):
+        codes = []
+        watchdog.set_abort_fn(codes.append)
+        watchdog.configure(rank=0, world_size=1, store=None,
+                           progress_dir=str(tmp_path))
+        watchdog.guarded_wait(lambda: False, "peer ack", timeout=0.15,
+                              interval_s=0.02)
+        assert codes == [75]
+
+    def test_guarded_wait_passes_when_ready(self):
+        codes = []
+        watchdog.set_abort_fn(codes.append)
+        watchdog.guarded_wait(lambda: True, "instant", timeout=0.5)
+        assert codes == []
+
+
+class TestWatchdogInertTripwire:
+    """Tier-1 tripwire: FLAGS_collective_timeout_s=0 (default) must add ZERO
+    overhead — no monitor thread, no store/file traffic, no host syncs."""
+
+    def test_disabled_guard_spawns_no_threads(self):
+        assert not watchdog.enabled()
+        before = {t.name for t in threading.enumerate()}
+        for _ in range(100):
+            with watchdog.guard("hot-path"):
+                pass
+        after = {t.name for t in threading.enumerate()}
+        assert "paddle-tpu-watchdog" not in after
+        assert after == before
+
+    def test_disabled_step_path_adds_no_syncs_or_trips(self):
+        from paddle_tpu import profiler
+
+        from paddle_tpu.core import lazy
+
+        watchdog.configure(rank=0, world_size=1, store=None, progress_dir=None)
+        c0 = dict(profiler.counters())
+        x = paddle_tpu.to_tensor(np.ones((4, 4), np.float32))
+        with lazy.lazy_guard(True):
+            y = (x * 2 + 1).sum()
+        val = float(y.numpy())  # one sanctioned readback
+        assert val == 48.0
+        c1 = profiler.counters()
+        assert c1.get("watchdog_trips", 0) == c0.get("watchdog_trips", 0)
+        # exactly the sanctioned block — the guard wrapped it but added none
+        assert "paddle-tpu-watchdog" not in {t.name for t in threading.enumerate()}
+
+    def test_flag_registered_and_default_zero(self):
+        assert fw_flags.flag("FLAGS_collective_timeout_s") == 0.0
+        assert watchdog.timeout_s() == 0.0
+
+
+class TestChaosPlumbing:
+    def test_rank_slow_delays_publish(self, tmp_path):
+        watchdog.configure(rank=0, world_size=1, store=None,
+                           progress_dir=str(tmp_path))
+        inject.arm({"rank.slow": {"ms": 80, "rank": 0}})
+        t0 = time.monotonic()
+        watchdog.publish(step=1, force=True)
+        assert time.monotonic() - t0 >= 0.08
+        assert "rank.slow" in inject.exercised()
+
+    def test_rank_filter_targets_one_rank(self):
+        inject.arm({"rank.kill": {"rank": 1}})
+        assert not inject.should_fire("rank.kill", step=0, rank=0)
+        assert inject.should_fire("rank.kill", step=0, rank=1)
+
+    def test_chaos_points_registered(self):
+        for point in ("rank.kill", "rank.hang", "rank.slow", "collective.drop"):
+            assert point in inject.POINTS
+
+    def test_kill_payload_default(self):
+        inject.arm({"rank.kill": {"exit": 99}})
+        assert inject.point_cfg("rank.kill")["exit"] == 99
+        inject.disarm()
+        assert inject.point_cfg("rank.kill") == {}
+
+
+# ------------------------------------------------------- sample-exact resume
+class _ArangeDS(Dataset):
+    def __init__(self, n=24):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i])
+
+    def __len__(self):
+        return self.n
+
+
+def _drain(it, n=None):
+    out = []
+    for b in it:
+        out.append(np.asarray(b._data).ravel().tolist())
+        if n is not None and len(out) >= n:
+            break
+    return out
+
+
+class TestSampleExactResume:
+    def test_loader_state_roundtrip_bit_exact(self):
+        ref = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=11)
+        ref_seq = []
+        for _ in range(2):
+            ref_seq += _drain(iter(ref))
+
+        a = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=11)
+        it = iter(a)
+        head = _drain(it, n=3)
+        sd = a.state_dict()
+        assert sd == {"epoch": 0, "batch_idx": 3, "seed": 11}
+
+        b = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=11)
+        b.load_state_dict(sd)
+        tail = []
+        while len(head) + len(tail) < len(ref_seq):
+            tail += _drain(iter(b))
+        assert head + tail == ref_seq
+
+    def test_epochs_reshuffle_but_are_reproducible(self):
+        a = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=5)
+        e0 = _drain(iter(a))
+        e1 = _drain(iter(a))
+        assert e0 != e1  # per-epoch reshuffle
+        b = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=5)
+        assert _drain(iter(b)) == e0 and _drain(iter(b)) == e1
+
+    def test_resume_skip_never_loads_skipped_samples(self):
+        loads = []
+
+        class TrackingDS(_ArangeDS):
+            def __getitem__(self, i):
+                loads.append(i)
+                return np.float32([i])
+
+        dl = DataLoader(TrackingDS(12), batch_size=2, shuffle=True, seed=3)
+        dl.load_state_dict({"epoch": 0, "batch_idx": 4, "seed": 3})
+        got = _drain(iter(dl))
+        assert len(got) == 2  # 6 batches/epoch, 4 skipped
+        assert len(loads) == 4  # only the two remaining batches were loaded
+
+    def test_seed_mismatch_adopts_checkpoint_seed(self):
+        dl = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=1)
+        with pytest.warns(UserWarning, match="adopting the checkpoint"):
+            dl.load_state_dict({"epoch": 0, "batch_idx": 0, "seed": 2})
+        ref = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=2)
+        assert _drain(iter(dl)) == _drain(iter(ref))
+
+    def test_seedless_loader_adopts_checkpoint_seed_exactly(self):
+        # loader built WITHOUT a seed (global-RNG shuffle): adopting the
+        # checkpoint's seed must also install the seeded sampler, or the
+        # replayed order silently stays irreproducible
+        dl = DataLoader(_ArangeDS(), batch_size=3, shuffle=True)
+        with pytest.warns(UserWarning, match="adopting the checkpoint"):
+            dl.load_state_dict({"epoch": 0, "batch_idx": 2, "seed": 7})
+        ref = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=7)
+        ref.load_state_dict({"epoch": 0, "batch_idx": 2, "seed": 7})
+        assert _drain(iter(dl)) == _drain(iter(ref))
+
+    def test_prefetcher_state_counts_consumed_not_staged(self):
+        dl = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=11,
+                        device_prefetch=3)
+        it = iter(dl)
+        head = []
+        for _ in range(2):
+            head.append(np.asarray(next(it)._data).ravel().tolist())
+        time.sleep(0.2)  # let the read-ahead run PAST the consumed position
+        sd = it.state_dict()
+        it.close()
+        assert sd["epoch"] == 0 and sd["batch_idx"] == 2
+
+        rest = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=11)
+        rest.load_state_dict(sd)
+        ref = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=11)
+        assert head + _drain(iter(rest)) == _drain(iter(ref))
+
+    def test_prefetcher_load_state_dict_rebinds(self):
+        dl = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=11)
+        pf = paddle_tpu.io.device_prefetch(dl, buffer_size=2)
+        pf.load_state_dict({"epoch": 0, "batch_idx": 4, "seed": 11})
+        got = _drain(pf)
+        ref = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=11)
+        assert got == _drain(iter(ref))[4:]
+
+    def test_prefetcher_rebind_on_prefetching_loader_drops_no_batches(self):
+        # the loader ITSELF prefetches (device_prefetch>0): rebinding must
+        # not spin up a nested prefetcher whose staged read-ahead is then
+        # thrown away — every post-restore batch must reach the trainer
+        dl = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=11,
+                        device_prefetch=2)
+        pf = iter(dl)
+        head = _drain(pf, n=2)
+        sd = pf.state_dict()
+        time.sleep(0.2)  # let the read-ahead run past the consumed position
+        pf.load_state_dict(sd)
+        got = head + _drain(pf, n=6)
+        ref = DataLoader(_ArangeDS(), batch_size=3, shuffle=True, seed=11)
+        assert got == _drain(iter(ref))
+        pf.close()
+
+    def test_program_rng_checkpoint_roundtrip(self, tmp_path):
+        from paddle_tpu.core import random as prandom
+        from paddle_tpu.distributed.checkpoint import (
+            load_state_dict, save_state_dict)
+
+        paddle_tpu.seed(123)
+        prandom.next_key()  # advance the stream past the seed point
+        tree = {"rng": paddle_tpu.program_rng,
+                "w": paddle_tpu.to_tensor(np.zeros(2, np.float32))}
+        save_state_dict(tree, str(tmp_path / "ck"), step=1)
+        expect = [np.asarray(prandom.next_key()).tolist() for _ in range(3)]
+
+        paddle_tpu.seed(999)  # clobber the stream
+        load_state_dict(tree, str(tmp_path / "ck"))
+        got = [np.asarray(prandom.next_key()).tolist() for _ in range(3)]
+        assert got == expect
