@@ -1,0 +1,68 @@
+"""graft-analyze — static & runtime invariant checking for the runtime.
+
+PRs 1-8 accumulated invariants the runtime's correctness silently depends
+on: donation masks must match liveness, cache/checkpoint/store writes must
+be tmp+``os.replace``-atomic (two torn-cache segfault incidents), raw
+``jax.*`` API use must route through ``core/compat.py``, deadlines must use
+monotonic clocks, and the async runtime's shared state is touched from
+worker threads across a dozen modules. Each of these used to be enforced
+only by incident-driven regression tests; this package turns them into
+machine-checked rules (the LazyTensor IR-checking discipline,
+arXiv:2102.13267) so the next violation is a lint/verify failure instead of
+a nondeterministic segfault.
+
+Three pillars:
+
+* :mod:`~paddle_tpu.analysis.verify_graph` — a structural verifier over the
+  pending lazy graph, run immediately before dispatch under
+  ``FLAGS_lazy_verify`` (default on in tests, off in production at a
+  one-flag-probe cost).
+* :mod:`~paddle_tpu.analysis.lint` — an AST repo-invariant linter (zero
+  third-party deps): hidden host syncs, compat-shim bypasses, non-atomic
+  writes, wall-clock deadlines, unregistered ``FLAGS_*``, bare excepts.
+  Inline suppression via ``# lint: ok(<rule>)``; grandfathered findings
+  live in ``baseline.txt`` with one-line justifications.
+* :mod:`~paddle_tpu.analysis.locks` — a lock-discipline checker over
+  ``# guarded_by: <lock>`` annotations, plus the opt-in runtime
+  ownership-assertion mode in :mod:`~paddle_tpu.analysis.thread_checks`
+  (``FLAGS_thread_checks``) that makes races fail deterministically.
+
+``python -m paddle_tpu.analysis`` runs all pillars and exits non-zero on
+any unsuppressed finding — wired into tier-1 as a tripwire test.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .lint import Finding, lint_package, load_baseline  # noqa: F401
+from .locks import check_lock_discipline  # noqa: F401
+
+__all__ = [
+    "Finding", "lint_package", "check_lock_discipline", "run_all",
+    "package_root", "baseline_path",
+]
+
+
+def package_root() -> str:
+    """The paddle_tpu package directory the analysis runs over."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.txt")
+
+
+def run_all(root: Optional[str] = None,
+            baseline: Optional[str] = None) -> List[Finding]:
+    """Run every static pillar over ``root`` (default: the installed
+    paddle_tpu package) and return the UNSUPPRESSED findings. The verifier
+    pillar is runtime (hooked into the lazy flush) — its self-check lives in
+    ``__main__`` and the test suite."""
+    root = root or package_root()
+    if baseline is None:
+        baseline = baseline_path()
+    base = load_baseline(baseline) if baseline and os.path.exists(baseline) else []
+    findings = lint_package(root, baseline=base)
+    findings += check_lock_discipline(root, baseline=base)
+    return findings

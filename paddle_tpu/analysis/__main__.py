@@ -1,0 +1,88 @@
+"""``python -m paddle_tpu.analysis`` — run every pillar, exit non-zero on
+any unsuppressed finding.
+
+Order: the two static pillars (linter, lock checker) over the package tree,
+then a runtime self-check of the lazy-graph verifier — a live graph must
+verify clean AND a deliberately corrupted copy must raise, so a silently
+broken verifier (the worst failure mode of a checker) also fails the run.
+
+Flags::
+
+    python -m paddle_tpu.analysis [--root DIR] [--no-baseline] [--no-selfcheck]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _verifier_selfcheck() -> int:
+    """0 on success. Builds a real pending graph, verifies it, then plants a
+    wiring corruption and requires the structured error."""
+    import numpy as np
+
+    from ..core import lazy
+    from .verify_graph import GraphInvariantError, verify_before_dispatch
+
+    import jax.numpy as jnp
+
+    lazy.flush()  # start from a clean epoch on this thread
+    a = jnp.asarray(np.arange(8.0, dtype=np.float32))
+    (x,), _ = lazy.record("selfcheck_add", jnp.add, [a, a])
+    (y,), _ = lazy.record("selfcheck_mul", jnp.multiply, [x, a])
+    g = lazy._state.graph
+    try:
+        verify_before_dispatch(g, (), None)
+    except GraphInvariantError as e:
+        print(f"verifier self-check FAILED: clean graph rejected: {e}")
+        return 1
+    # plant a forward reference (node 0 reading node 1's output = a cycle)
+    good = g.descs[0]
+    g.descs[0] = (("n", 1, 0),) + tuple(good[1:])
+    try:
+        verify_before_dispatch(g, (), None)
+        print("verifier self-check FAILED: seeded cycle not detected")
+        return 1
+    except GraphInvariantError:
+        pass
+    finally:
+        g.descs[0] = good
+        del x, y
+        lazy._state.graph = None  # drop the probe graph, no dispatch needed
+    return 0
+
+
+def main(argv=None) -> int:
+    from . import baseline_path, package_root, run_all
+
+    ap = argparse.ArgumentParser(prog="python -m paddle_tpu.analysis")
+    ap.add_argument("--root", default=None, help="package dir to analyze")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--no-selfcheck", action="store_true",
+                    help="skip the runtime verifier self-check (no jax import)")
+    args = ap.parse_args(argv)
+
+    root = args.root or package_root()
+    findings = run_all(root, baseline="" if args.no_baseline else None)
+    for f in findings:
+        print(f)
+    rc = 0
+    if findings:
+        print(f"\n{len(findings)} unsuppressed finding(s) "
+              f"(suppress inline with '# lint: ok(<rule>)' or baseline with "
+              "a justification in paddle_tpu/analysis/baseline.txt)")
+        rc = 1
+    else:
+        print(f"analysis clean over {root}")
+    if not args.no_selfcheck:
+        src = _verifier_selfcheck()
+        if src == 0:
+            print("lazy-graph verifier self-check OK "
+                  "(clean graph accepted, seeded cycle rejected)")
+        rc = rc or src
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,466 @@
+"""Repo-invariant linter (AST-based, zero third-party deps).
+
+Each rule encodes an invariant a past incident or PR established:
+
+* ``host-sync`` — hidden host synchronization in hot paths. ``.numpy()`` /
+  ``.item()`` calls and ``np.asarray(x._data)`` inside ``core/``,
+  ``distributed/`` and ``optimizer/`` force a device wait that bypasses the
+  attributed ``lazy.timed_block`` funnel — invisible dispatch-gap time the
+  async runtime (PR 6) exists to eliminate.
+* ``compat-shim`` — direct use of a ``jax.*`` name the one-file shim in
+  ``core/compat.py`` wraps (``shard_map``, ``export``, ``enable_x64``,
+  ``axis_size``). Version drift in these silently dropped three files from
+  tier-1 before PR 1 centralized them.
+* ``atomic-write`` — a file opened for (over)write, or ``write_bytes`` /
+  ``write_text``, in a function that never calls ``os.replace``: a process
+  killed mid-write leaves a torn file. Two such torn persistent-cache
+  entries produced deterministic segfaults (PR 3, PR 4); every
+  cache/checkpoint/store/progress write must be tmp + ``os.replace``.
+* ``monotonic-deadline`` — ``time.time()`` feeding deadline/timeout/
+  interval arithmetic. Wall clocks jump (NTP, VM migration); a backward
+  step turns a 30 s timeout into hours. Deadlines use ``time.monotonic()``;
+  wall time is for human-facing timestamps only.
+* ``flag-registry`` — a ``FLAGS_*`` name referenced somewhere in the tree
+  but never present in ``framework/flags.py`` nor passed to
+  ``register_flag``: the typo guard in ``set_flags`` can only reject what
+  the registry knows about.
+* ``bare-except`` — a bare ``except:`` (or ``except BaseException`` that
+  does not re-raise) in retry/commit paths swallows ``KeyboardInterrupt``/
+  ``SystemExit`` and can convert a preemption drain into a hang.
+
+Suppression grammar: ``# lint: ok(<rule>)`` on the offending line (or the
+line directly above it). Grandfathered findings live in ``baseline.txt`` —
+one ``rule<TAB>path<TAB>scope<TAB># justification`` line each, matched on
+(rule, file, enclosing function) so they survive line drift.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding", "lint_package", "lint_source", "load_baseline",
+    "iter_py_files", "RULES",
+]
+
+RULES = (
+    "host-sync", "compat-shim", "atomic-write", "monotonic-deadline",
+    "flag-registry", "bare-except",
+)
+
+# host-sync applies only to hot-path packages (metric/, hapi/ etc. read
+# results by design); paths are package-relative, '/'-normalized
+_HOST_SYNC_SCOPE = ("core/", "distributed/", "optimizer/")
+
+# jax names whose only sanctioned home is core/compat.py
+_SHIM_ATTRS = {"shard_map", "enable_x64"}
+_SHIM_MODULES = {
+    "jax.experimental.shard_map", "jax.experimental.export", "jax.export",
+}
+_DEADLINE_WORD = re.compile(r"deadline|timeout|expire|interval", re.IGNORECASE)
+_SUPPRESS = re.compile(r"#\s*lint:\s*ok\(([a-z0-9_,\- ]+)\)")
+_WRITE_MODES = ("w", "wb", "w+", "wb+", "x", "xb")
+_MUTATING_WRITES = {"write_bytes", "write_text"}
+_EXCEPT_SCOPE = ("fault/", "distributed/checkpoint.py", "distributed/coord.py",
+                 "distributed/watchdog.py")
+
+
+class Finding:
+    """One linter/lock-checker finding. ``scope`` is the enclosing function
+    qualname (or ``<module>``) — the stable anchor baseline entries match."""
+
+    __slots__ = ("rule", "path", "line", "scope", "message")
+
+    def __init__(self, rule: str, path: str, line: int, scope: str, message: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.scope = scope
+        self.message = message
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.scope)
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] ({self.scope}) {self.message}"
+
+
+def load_baseline(path: str) -> List[Tuple[str, str, str]]:
+    """Parse the baseline file: ``rule<TAB>relpath<TAB>scope<TAB># why``
+    per entry; blank lines and ``#`` comment lines ignored. A justification
+    comment is REQUIRED — an unexplained entry is itself an error."""
+    out: List[Tuple[str, str, str]] = []
+    with open(path) as f:
+        for ln, raw in enumerate(f, 1):
+            line = raw.rstrip("\n")
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+            parts = line.split("\t")
+            if len(parts) < 4 or not parts[3].lstrip().startswith("#"):
+                raise ValueError(
+                    f"{path}:{ln}: baseline entry needs "
+                    "rule<TAB>path<TAB>scope<TAB># justification"
+                )
+            if parts[0] not in RULES and not parts[0].startswith("lock-"):
+                raise ValueError(f"{path}:{ln}: unknown rule {parts[0]!r}")
+            out.append((parts[0], parts[1], parts[2]))
+    return out
+
+
+def iter_py_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def _suppressed_lines(source: str) -> Dict[int, Set[str]]:
+    """line -> set of rules suppressed there. A marker also covers the NEXT
+    line, so it can sit above a long statement."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _SUPPRESS.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out.setdefault(i, set()).update(rules)
+            out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+class _ScopeVisitor(ast.NodeVisitor):
+    """Base visitor tracking the enclosing function qualname."""
+
+    def __init__(self):
+        self._scope: List[str] = []
+
+    def scope(self) -> str:
+        return ".".join(self._scope) if self._scope else "<module>"
+
+    def _visit_func(self, node):
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node):
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+
+def _dotted(node) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _names_in(node) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+    return out
+
+
+class _Linter(_ScopeVisitor):
+    def __init__(self, relpath: str, tree: ast.AST):
+        super().__init__()
+        self.relpath = relpath
+        self.findings: List[Finding] = []
+        self.flag_refs: List[Tuple[int, str, str]] = []  # (line, scope, name)
+        self.flag_registered: Set[str] = set()
+        # per-function: does it call os.replace (or equivalent rename)?
+        self._atomic_funcs = self._collect_atomic_functions(tree)
+        self._func_stack: List[ast.AST] = []
+        # names assigned from time.time() in the current function
+        self._wall_names: List[Set[str]] = [set()]
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _collect_atomic_functions(tree) -> Set[ast.AST]:
+        """Function nodes whose body (own statements, not nested defs'
+        bodies excluded — a helper closure doing the replace still makes the
+        write pattern atomic) contains an ``os.replace``/``os.rename``."""
+        atomic: Set[ast.AST] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        dn = _dotted(sub.func)
+                        term = dn.rsplit(".", 1)[-1] if dn else None
+                        if dn in ("os.replace", "os.rename") or term in (
+                            "atomic_open", "atomic_write"
+                        ):
+                            atomic.add(node)
+                            break
+        return atomic
+
+    def _emit(self, rule, node, message):
+        self.findings.append(
+            Finding(rule, self.relpath, node.lineno, self.scope(), message)
+        )
+
+    def _in_host_sync_scope(self) -> bool:
+        return self.relpath.startswith(_HOST_SYNC_SCOPE)
+
+    def _in_except_scope(self) -> bool:
+        return self.relpath.startswith(_EXCEPT_SCOPE)
+
+    # -- scope bookkeeping -------------------------------------------------
+    def _visit_func(self, node):
+        self._func_stack.append(node)
+        self._wall_names.append(set())
+        _ScopeVisitor._visit_func(self, node)
+        self._wall_names.pop()
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- rules -------------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        dn = _dotted(node.func)
+
+        # host-sync: .numpy()/.item() and np.asarray(x._data) in hot paths
+        if self._in_host_sync_scope() and isinstance(node.func, ast.Attribute):
+            if node.func.attr in ("numpy", "item") and not node.args and not node.keywords:
+                self._emit(
+                    "host-sync", node,
+                    f".{node.func.attr}() forces a host sync; route readbacks "
+                    "through lazy.timed_block (Tensor.numpy) or defer them",
+                )
+        if (
+            self._in_host_sync_scope()
+            and dn in ("np.asarray", "numpy.asarray")
+            and node.args
+            and isinstance(node.args[0], ast.Attribute)
+            and node.args[0].attr == "_data"
+        ):
+            self._emit(
+                "host-sync", node,
+                "np.asarray(x._data) blocks on the raw buffer, bypassing the "
+                "attributed timed_block readback funnel",
+            )
+
+        # compat-shim: direct jax.<wrapped name> call/attribute use
+        if dn is not None and self.relpath != "core/compat.py":
+            if (
+                (dn.startswith("jax.") and dn.split(".")[-1] in _SHIM_ATTRS)
+                or dn == "jax.export" or dn.startswith("jax.export.")
+            ):
+                self._emit(
+                    "compat-shim", node,
+                    f"direct {dn} use; route through core/compat.py (the "
+                    "public home of this API moved between jax releases)",
+                )
+            if dn in ("lax.axis_size", "jax.lax.axis_size"):
+                self._emit(
+                    "compat-shim", node,
+                    "lax.axis_size only exists on newer jax; use "
+                    "core.compat.axis_size",
+                )
+
+        # atomic-write: open(..., 'w'/'wb') / write_bytes / write_text in a
+        # function with no os.replace
+        mode = None
+        if dn in ("open", "io.open") and len(node.args) >= 2:
+            a = node.args[1]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                mode = a.value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        is_write = (
+            (dn in ("open", "io.open") and mode in _WRITE_MODES)
+            or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATING_WRITES
+            )
+        )
+        if is_write and not (self._func_stack and self._func_stack[-1] in self._atomic_funcs):
+            what = mode and f"open(..., {mode!r})" or f".{node.func.attr}(...)"
+            self._emit(
+                "atomic-write", node,
+                f"{what} with no os.replace in the enclosing function — a "
+                "mid-write kill leaves a torn file; write tmp + os.replace",
+            )
+
+        # monotonic-deadline: time.time() directly inside deadline math
+        if dn == "time.time":
+            names = _names_in(self._current_stmt or node)
+            if any(_DEADLINE_WORD.search(n) for n in names):
+                self._emit(
+                    "monotonic-deadline", node,
+                    "time.time() in deadline/timeout arithmetic — wall clocks "
+                    "jump; use time.monotonic()",
+                )
+
+        # flag-registry: collect FLAGS_* string references. Matched on the
+        # terminal attribute so chained receivers (`_flags_mod().flag(...)`)
+        # are caught too.
+        fname = (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else node.func.id if isinstance(node.func, ast.Name) else None
+        )
+        if fname in ("flag", "register_flag", "get_flags"):
+            for a in node.args[:1]:
+                if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+                        and a.value.startswith("FLAGS_"):
+                    if fname == "register_flag":
+                        self.flag_registered.add(a.value)
+                    else:
+                        self.flag_refs.append((node.lineno, self.scope(), a.value))
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import):
+        if self.relpath != "core/compat.py":
+            for alias in node.names:
+                if alias.name in _SHIM_MODULES:
+                    self._emit(
+                        "compat-shim", node,
+                        f"import {alias.name}; route through core/compat.py",
+                    )
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if self.relpath != "core/compat.py" and node.module:
+            wrapped = {"shard_map", "export", "enable_x64"}
+            if node.module in _SHIM_MODULES or (
+                node.module in ("jax", "jax.experimental")
+                and any(a.name in wrapped for a in node.names)
+            ):
+                self._emit(
+                    "compat-shim", node,
+                    f"from {node.module} import "
+                    f"{', '.join(a.name for a in node.names)}; route through "
+                    "core/compat.py",
+                )
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        # taint-track `now = time.time()` so a later `now - t0 > timeout`
+        # compare in the same function is still caught
+        if (
+            isinstance(node.value, ast.Call)
+            and _dotted(node.value.func) == "time.time"
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._wall_names[-1].add(t.id)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        names = _names_in(node)
+        tainted = names & self._wall_names[-1]
+        if tainted and any(_DEADLINE_WORD.search(n) for n in names):
+            self._emit(
+                "monotonic-deadline", node,
+                f"wall-clock value {sorted(tainted)[0]!r} (from time.time()) "
+                "compared against a deadline/timeout — use time.monotonic()",
+            )
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if self._in_except_scope():
+            bare = node.type is None
+            base = (
+                isinstance(node.type, ast.Name) and node.type.id == "BaseException"
+            )
+            if bare or base:
+                reraises = any(
+                    isinstance(s, ast.Raise) and s.exc is None
+                    for s in ast.walk(node)
+                )
+                if not reraises:
+                    self._emit(
+                        "bare-except", node,
+                        ("bare except" if bare else "except BaseException") +
+                        " without re-raise in a retry/commit path swallows "
+                        "KeyboardInterrupt/SystemExit",
+                    )
+        self.generic_visit(node)
+
+    # flag-registry also needs FLAGS_* dict keys (the registry itself) and
+    # env-pickup string literals; collect registrations from flags.py keys
+    def visit_Dict(self, node: ast.Dict):
+        if self.relpath == "framework/flags.py":
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                        and k.value.startswith("FLAGS_"):
+                    self.flag_registered.add(k.value)
+        self.generic_visit(node)
+
+    # track the current top-level statement for expression-local name scans
+    _current_stmt: Optional[ast.stmt] = None
+
+    def visit(self, node):
+        if isinstance(node, ast.stmt):
+            self._current_stmt = node
+        return super().visit(node)
+
+
+def lint_source(source: str, relpath: str) -> Tuple[List[Finding], List, Set[str]]:
+    """Lint one file. Returns (findings, flag_refs, flags_registered) — the
+    flag data is resolved cross-file by :func:`lint_package`."""
+    tree = ast.parse(source, filename=relpath)
+    linter = _Linter(relpath, tree)
+    linter.visit(tree)
+    suppressed = _suppressed_lines(source)
+    kept = [
+        f for f in linter.findings
+        if f.rule not in suppressed.get(f.line, ())
+    ]
+    refs = [(relpath, ln, scope, name) for ln, scope, name in linter.flag_refs]
+    return kept, refs, linter.flag_registered
+
+
+def _apply_baseline(findings: Sequence[Finding],
+                    baseline: Sequence[Tuple[str, str, str]]) -> List[Finding]:
+    allowed = set(baseline)
+    return [f for f in findings if f.key() not in allowed]
+
+
+def lint_package(root: str,
+                 baseline: Sequence[Tuple[str, str, str]] = ()) -> List[Finding]:
+    """Lint every .py file under ``root`` (a package directory); resolve the
+    cross-file flag-registry rule; subtract baseline entries."""
+    findings: List[Finding] = []
+    all_refs: List[Tuple[str, int, str, str]] = []
+    registered: Set[str] = set()
+    for path in iter_py_files(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            file_findings, refs, regs = lint_source(source, rel)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "parse-error", rel, e.lineno or 0, "<module>",
+                f"file does not parse: {e.msg}",
+            ))
+            continue
+        findings.extend(file_findings)
+        all_refs.extend(refs)
+        registered |= regs
+    for rel, ln, scope, name in all_refs:
+        if name not in registered:
+            findings.append(Finding(
+                "flag-registry", rel, ln, scope,
+                f"{name} referenced but never registered in framework/flags.py "
+                "(set_flags typo-guard cannot protect it)",
+            ))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return _apply_baseline(findings, baseline)

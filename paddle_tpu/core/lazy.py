@@ -223,7 +223,10 @@ class LazyArray:
         return int(self._value())
 
     def __format__(self, spec):
-        return format(np.asarray(self._value()) if self.ndim else self._value().item(), spec)
+        # the wait is attributed like every other readback (block span +
+        # lazy_block_ns) — an f-string on a pending loss is a host sync too
+        v = timed_block(self._value())
+        return format(np.asarray(v) if self.ndim else v.item(), spec)  # lint: ok(host-sync)
 
     @staticmethod
     def _rev(fn):
@@ -571,7 +574,12 @@ def record(name, fn, inputs, key=None):
 
 
 # -- flush -------------------------------------------------------------------
-_flush_cache: "collections.OrderedDict" = collections.OrderedDict()
+# The executable cache is shared by every thread running lazy mode (graphs
+# are thread-local, compiled steps are not) — an OrderedDict's reorder/evict
+# is not atomic, so probes and inserts serialize on _cache_lock (one
+# uncontended acquire per flush; the lock is NOT held across trace/compile).
+_cache_lock = threading.Lock()
+_flush_cache: "collections.OrderedDict" = collections.OrderedDict()  # guarded_by: _cache_lock
 _FLUSH_CACHE_MAX = 128
 
 
@@ -694,6 +702,13 @@ def _enqueue_deferred(sp, check_payload, census, results):
         d = []
         _state.deferred = d
     d.append((sp, check_payload, census, results))
+    # verify at ENQUEUE time: flush() drains this queue before the next
+    # _flush_impl runs, so a pre-dispatch check there would only ever see an
+    # empty queue — here is the one point a malformed entry can exist
+    if _flags_mod().flag("FLAGS_lazy_verify", False):
+        from ..analysis.verify_graph import _verify_deferred
+
+        _verify_deferred(d)
 
 
 def _drain_deferred():
@@ -843,6 +858,17 @@ def _flush_impl(g: _Graph, sp=None):
     if cand:
         cand.clear()
 
+    # Graph IR verifier (analysis/verify_graph.py): re-derive the wiring /
+    # leaf table / donation mask / signature from ground truth and cross-
+    # check the record-time memoization, BEFORE anything is dispatched or
+    # cached. Off by default — this probe is the entire disabled-path cost.
+    if _flags.flag("FLAGS_lazy_verify", False):
+        from ..analysis.verify_graph import verify_before_dispatch
+
+        # deferred entries are verified where they are enqueued (see
+        # _enqueue_deferred) — by this point flush() has already drained them
+        verify_before_dispatch(g, donate_ix)
+
     try:
         sig = (tuple(g.keyparts), alive_parts, tuple(g.leaf_avals), donate_ix)
         hash(sig)
@@ -854,7 +880,10 @@ def _flush_impl(g: _Graph, sp=None):
     prof = _prof()
     prof.counter_inc("lazy_flushes")
 
-    entry = _flush_cache.get(sig) if sig is not None else None
+    with _cache_lock:
+        entry = _flush_cache.get(sig) if sig is not None else None
+        if entry is not None:
+            _flush_cache.move_to_end(sig)
     cache_hit = entry is not None
     if sp is not None:
         # the executable-cache key: stable within a process (str hashing is
@@ -897,11 +926,11 @@ def _flush_impl(g: _Graph, sp=None):
             # non-donating executable under the same signature
             entry = [jitted, live, replay, donate_ix, None]
         if sig is not None:
-            _flush_cache[sig] = entry
-            if len(_flush_cache) > _FLUSH_CACHE_MAX:
-                _flush_cache.popitem(last=False)
+            with _cache_lock:
+                _flush_cache[sig] = entry
+                if len(_flush_cache) > _FLUSH_CACHE_MAX:
+                    _flush_cache.popitem(last=False)
     else:
-        _flush_cache.move_to_end(sig)
         prof.counter_inc("lazy_cache_hits")
 
     jitted, live, replay, don, task = entry

@@ -511,8 +511,12 @@ class AutoCheckpoint:
             warnings.warn(f"checkpoint save at step {step} failed (skipped): {e!r}")
             return False
         self._pending = pend
-        with open(self._meta_path(), "w") as f:
-            # legacy pointer only — resume verifies manifests instead
+        # legacy pointer only — resume verifies manifests instead; still
+        # written atomically so a kill here can't leave torn JSON for any
+        # legacy reader of latest.json
+        from ..framework.io import atomic_open
+
+        with atomic_open(self._meta_path(), "w") as f:
             json.dump({"step": step, "ts": time.time()}, f)
         self._gc()
         return True

@@ -36,15 +36,20 @@ __all__ = [
 
 _flags.register_flag("FLAGS_collective_timeout_s", 0.0)
 
-_lock = threading.Lock()
-_cfg: Optional[dict] = None          # {rank, world_size, store, progress_dir}
-_local: Dict[str, object] = {}       # this rank's last progress record
-_last_push = 0.0
+# RLock, not Lock: FLAGS_thread_checks verifies mutations via the lock's
+# ownership (`_is_owned`), which a plain Lock cannot answer — `locked()`
+# is true when ANY thread holds it, a false negative for exactly the races
+# the runtime mode exists to catch. Never re-entered in this module.
+_lock = threading.RLock()
+_cfg: Optional[dict] = None          # guarded_by: _lock
+_local: Dict[str, object] = {}       # guarded_by: _lock
+_last_push = 0.0                     # guarded_by: _lock
 _PUSH_INTERVAL_S = 0.2               # rate limit on store/file write-through
 
-_guards: Dict[int, Tuple[float, str]] = {}   # token -> (deadline_monotonic, what)
+# token -> (deadline_monotonic, what)
+_guards: Dict[int, Tuple[float, str]] = {}   # guarded_by: _lock
 _guard_ids = iter(range(1, 1 << 62)).__next__
-_monitor: Optional[threading.Thread] = None
+_monitor: Optional[threading.Thread] = None  # guarded_by: _lock
 _monitor_wake = threading.Event()
 _monitor_stop = threading.Event()
 
@@ -87,7 +92,7 @@ def configure(
     PADDLE_TPU_PROGRESS_DIR / PADDLE_TPU_STORE_DIR). Also registers the
     progress table as a flight-recorder context provider, so EVERY crash
     dump carries the cross-rank view."""
-    global _cfg
+    global _cfg, _guards, _local
     if rank is None:
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if world_size is None:
@@ -101,6 +106,13 @@ def configure(
     if progress_dir:
         os.makedirs(progress_dir, exist_ok=True)
     with _lock:
+        # FLAGS_thread_checks: wrap the shared tables so an unguarded
+        # mutation anywhere raises at the mutation site (no-op when off,
+        # identity when already wrapped)
+        from ..analysis import thread_checks
+
+        _guards = thread_checks.guarded(_guards, _lock, "watchdog._guards")
+        _local = thread_checks.guarded(_local, _lock, "watchdog._local")
         _cfg = {
             "rank": int(rank),
             "world_size": int(world_size),
@@ -121,8 +133,15 @@ def reset() -> None:
     """Drop the session (tests). Outstanding guards are cleared and the
     monitor thread (if any) is stopped — after reset the process is back to
     the zero-thread disabled state the inert tripwire pins."""
-    global _cfg, _monitor
+    global _cfg, _monitor, _guards, _local
     with _lock:
+        from ..analysis import thread_checks
+
+        # drop any FLAGS_thread_checks proxies installed by configure() so
+        # the disabled state is byte-identical to a fresh import (the inert
+        # tripwire measures THIS state)
+        _guards = thread_checks.unwrap(_guards)
+        _local = thread_checks.unwrap(_local)
         _cfg = None
         _local.clear()
         _guards.clear()
@@ -131,7 +150,8 @@ def reset() -> None:
         _monitor_stop.set()
         _monitor_wake.set()
         t.join(timeout=2.0)
-    _monitor = None
+    with _lock:
+        _monitor = None
     _monitor_stop.clear()
     _monitor_wake.clear()
     try:
@@ -174,7 +194,8 @@ def publish(step: Optional[int] = None, phase: Optional[str] = None,
     if cfg is None:
         return
     global _last_push
-    now = time.time()
+    now = time.time()       # record timestamp: peers compare it cross-process
+    mono = time.monotonic()  # rate-limit clock: immune to wall-clock jumps
     with _lock:
         if step is not None:
             _local["step"] = int(step)
@@ -184,9 +205,9 @@ def publish(step: Optional[int] = None, phase: Optional[str] = None,
             _local["span"] = str(span)
         _local["ts"] = now
         rec = dict(_local)
-        due = force or (now - _last_push) >= _PUSH_INTERVAL_S
+        due = force or (mono - _last_push) >= _PUSH_INTERVAL_S
         if due:
-            _last_push = now
+            _last_push = mono
     if not due:
         return
     payload = json.dumps(rec)

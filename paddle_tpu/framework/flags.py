@@ -29,6 +29,20 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_use_bf16_matmul": True,         # TPU-native: allow bf16 matmul precision
     "FLAGS_jit_cache_size": 4096,
     "FLAGS_log_level": 0,
+    # Lazy-graph IR verifier (analysis/verify_graph.py): re-derive and
+    # cross-check the pending graph's wiring, leaf table, donation mask and
+    # cache signature immediately before every dispatch, raising a
+    # structured GraphInvariantError naming the offending node. Default on
+    # in the test suite (conftest); off in production, where the disabled
+    # path costs one flag probe per flush (bench_verify_overhead pins the
+    # enabled cost <2% on the CPU LeNet loop).
+    "FLAGS_lazy_verify": False,
+    # Runtime ownership assertions (analysis/thread_checks.py): wrap
+    # `# guarded_by:`-annotated shared structures in proxies that make an
+    # unguarded/foreign-thread mutation raise at the mutation site, so races
+    # fail deterministically in the chaos/async suites instead of corrupting
+    # a table. Opt-in; consulted at structure WRAP time, not per mutation.
+    "FLAGS_thread_checks": False,
     # Lazy-flush buffer donation: dead-after-flush inputs (rebound params,
     # optimizer moments, accumulated grads) are passed as donate_argnums so
     # XLA updates weights in place instead of copying ~3x model size per

@@ -9,8 +9,11 @@ reference's plain numpy-pickle state-dict format.
 """
 from __future__ import annotations
 
+import contextlib
+import itertools
 import os
 import pickle
+import threading
 from typing import Any
 
 import numpy as np
@@ -18,6 +21,34 @@ import numpy as np
 from ..core.tensor import Tensor, Parameter
 
 _TENSOR_KEY = "__paddle_tpu_tensor__"
+
+_tmp_seq = itertools.count(1)  # same-process same-path writers get unique tmps
+
+
+@contextlib.contextmanager
+def atomic_open(path: str, mode: str = "w"):
+    """Open a tmp file beside ``path`` and ``os.replace`` it over ``path``
+    on clean exit (removed on error). Readers concurrently — or after a
+    mid-write SIGKILL — see the old content or the complete new write,
+    never a torn file. The repo-wide idiom for every artifact another
+    process may read (lint rule ``atomic-write``; two torn-cache segfault
+    incidents, PR 3 / PR 4). The tmp name carries pid, thread id and a
+    sequence number: two THREADS of one process writing the same path must
+    not truncate each other's in-flight tmp — last replace wins with a
+    complete file either way."""
+    tmp = (
+        f"{path}.tmp{os.getpid()}-{threading.get_ident()}-{next(_tmp_seq)}"
+    )
+    try:
+        with open(tmp, mode) as f:
+            yield f
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class _TensorPayload:
@@ -95,7 +126,9 @@ def save(obj, path, protocol=4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
+    # atomic: a kill mid-pickle must not leave a truncated state file where
+    # a resumable checkpoint used to be
+    with atomic_open(path, "wb") as f:
         pickle.dump(_pack(obj), f, protocol=protocol)
 
 

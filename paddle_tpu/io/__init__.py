@@ -579,7 +579,13 @@ class DevicePrefetcher:
         self._source = it
         self._it = iter(it)
         self._sharding = sharding
-        self._consumed = 0  # batches handed to the trainer (NOT read-ahead)
+        # batches handed to the trainer (NOT read-ahead) — the sample-exact-
+        # resume anchor, boxed so FLAGS_thread_checks can pin its mutations
+        # to the single consumer thread (a second thread iterating the same
+        # prefetcher would silently skew resume positions)
+        from ..analysis.thread_checks import owned as _owned
+
+        self._consumed = _owned([0], "DevicePrefetcher._consumed")
         self._q: _queue.Queue = _queue.Queue(maxsize=max(1, int(buffer_size)))
         self._stop = threading.Event()
         # The worker must NOT hold a strong ref to self (a bound-method
@@ -677,7 +683,7 @@ class DevicePrefetcher:
         if kind == "err":
             self.close()
             raise payload
-        self._consumed += 1
+        self._consumed[0] += 1
         return payload
 
     # -- sample-exact resume ------------------------------------------------
@@ -701,7 +707,7 @@ class DevicePrefetcher:
                 "DevicePrefetcher.state_dict: source iterator does not track "
                 "loader position (wrap a DataLoader, not a bare iterable)"
             )
-        return ei.state_at(self._consumed)
+        return ei.state_at(self._consumed[0])
 
     def load_state_dict(self, sd: dict) -> None:
         """Rebind to the source loader's restored position. Tears down the
@@ -715,7 +721,11 @@ class DevicePrefetcher:
             )
         self.close()
         loader.load_state_dict(sd)
-        self._consumed = 0
+        # fresh box: the restore may hand consumption to a new trainer
+        # thread, which becomes the owner on its first batch
+        from ..analysis.thread_checks import owned as _owned
+
+        self._consumed = _owned([0], "DevicePrefetcher._consumed")
         # rebind to the position-tracking iterator DIRECTLY: iter(loader) on
         # a device_prefetch>0 loader would return a nested prefetcher whose
         # worker starts staging batches immediately — adopting its inner

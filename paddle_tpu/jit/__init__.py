@@ -421,7 +421,9 @@ def save(layer, path, input_spec=None, **configs):
             for s in specs
         ]
         exported = _export(args)
-    with open(path + ".pdmodel", "wb") as f:
+    from ..framework.io import atomic_open
+
+    with atomic_open(path + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
     state = {k: np.asarray(v._data) for k, v in named_state}
     from ..framework.io import save as fsave
@@ -469,9 +471,9 @@ def save(layer, path, input_spec=None, **configs):
             except Exception:
                 # vjp not shape-polymorphic for some op: static fallback
                 exp_train = _jax_export().export(jax.jit(pure_train))(p_args, *static_args)
-            with open(path + ".pdtrain", "wb") as f:
+            with atomic_open(path + ".pdtrain", "wb") as f:
                 f.write(exp_train.serialize(vjp_order=1))
-            with open(path + ".pdtrain.json", "w") as f:
+            with atomic_open(path + ".pdtrain.json", "w") as f:
                 json.dump({"param_names": p_names}, f)
         except Exception:
             # not exportable with vjp (e.g. non-differentiable custom calls):

@@ -164,14 +164,20 @@ def chrome_trace(path: str) -> None:
         "displayTimeUnit": "ms",
         "metadata": metrics_snapshot(),
     }
-    with open(path, "w") as f:
+    from ..framework.io import atomic_open
+
+    # a trace viewer (or collector) opening the file mid-export must see the
+    # previous trace or the whole new one, never a truncated JSON document
+    with atomic_open(path, "w") as f:
         json.dump(doc, f, default=str)
 
 
 def jsonl(path: str) -> None:
     """One JSON object per line: ``{"type": "span"|"event"|"metrics", ...}``
     — greppable without a trace viewer (``grep lazy_flush trace.jsonl``)."""
-    with open(path, "w") as f:
+    from ..framework.io import atomic_open
+
+    with atomic_open(path, "w") as f:
         for s in merged_spans():
             f.write(json.dumps({"type": "span", **s}, default=str) + "\n")
         for e in merged_events():
@@ -220,6 +226,10 @@ def export_metrics(path: Optional[str] = None, format: str = "json") -> str:
     else:
         raise ValueError(f"unknown metrics format {format!r}")
     if path is not None:
-        with open(path, "w") as f:
+        # the textfile-collector pattern reads this concurrently: a torn
+        # metrics file is a scrape error at best, silent bad data at worst
+        from ..framework.io import atomic_open
+
+        with atomic_open(path, "w") as f:
             f.write(text)
     return text

@@ -49,9 +49,11 @@ try:
     _CAPACITY = int(os.environ.get("PADDLE_TPU_FLIGHT_CAPACITY", "256") or 256)
 except ValueError:  # a malformed diagnostics knob must not take down import
     _CAPACITY = 256
+# the ring is deliberately lock-free: deque.append with a maxlen is atomic
+# under the GIL, and record() is the per-span hot path
 _ring: "collections.deque" = collections.deque(maxlen=max(_CAPACITY, 8))
 _lock = threading.Lock()
-_last_dump: Optional[str] = None
+_last_dump: Optional[str] = None  # guarded_by: _lock
 _dump_seq = itertools.count(1)  # same-millisecond dumps must not collide
 
 
@@ -156,8 +158,24 @@ def dump(reason: str, extra: Optional[dict] = None, path: Optional[str] = None) 
                 f"flight_{os.getpid()}_{int(time.time() * 1000)}"
                 f"_{next(_dump_seq)}_{reason}.json",
             )
-        with open(path, "w") as f:
-            json.dump(doc, f, default=str)
+        # tmp + os.replace: a monitoring agent tailing the dump dir (or a
+        # relaunch reading its predecessor's post-mortem) must never see a
+        # half-written document. Hand-rolled rather than framework.io's
+        # atomic_open — the dumping process is often mid-crash and this path
+        # must depend on nothing beyond os/json.
+        # _dump_seq in the tmp name: two threads dumping to one explicit
+        # `path` must not truncate each other's in-flight tmp
+        tmp = f"{path}.tmp{os.getpid()}_{next(_dump_seq)}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, path)
+        except Exception:
+            try:
+                os.remove(tmp)  # no .tmp litter where an agent is tailing
+            except OSError:
+                pass
+            raise
     except Exception:
         return None
     with _lock:

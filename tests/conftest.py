@@ -7,6 +7,13 @@ mesh — the TPU analogue of the reference's multiprocess-on-one-host trick
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell may point at a TPU tunnel
+# Lazy-graph IR verifier (analysis/verify_graph.py): default ON for the whole
+# suite via the flags env pickup — every flush in every test re-checks the
+# wiring/leaf-table/donation/signature invariants, so a record-time
+# bookkeeping slip fails as a structured GraphInvariantError at its flush
+# instead of as a wrong cached executable three tests later. Production
+# default stays off (one flag probe per flush, pinned by a tripwire).
+os.environ.setdefault("FLAGS_lazy_verify", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"

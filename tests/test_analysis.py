@@ -1,0 +1,572 @@
+"""graft-analyze: repo-invariant linter, lock-discipline checker, runtime
+thread checks, and the tier-1 tree-clean tripwire.
+
+The tripwire test IS the CI gate: `python -m paddle_tpu.analysis` semantics
+run in-process over the installed package, failing on any unsuppressed
+finding. Every rule also gets a seeded violation proving it still catches
+what it claims to.
+"""
+import os
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (package import before analysis)
+from paddle_tpu import analysis
+from paddle_tpu.analysis import lint as lint_mod
+from paddle_tpu.analysis import locks as locks_mod
+from paddle_tpu.analysis import thread_checks
+from paddle_tpu.framework import flags
+
+
+def _lint(source, relpath):
+    findings, _refs, _regs = lint_mod.lint_source(textwrap.dedent(source), relpath)
+    return findings
+
+
+# -- the tier-1 gate ----------------------------------------------------------
+class TestTreeClean:
+    def test_package_has_no_unsuppressed_findings(self):
+        """Tier-1 tripwire: the full analysis over paddle_tpu/ must be clean
+        (an empty or justified-only baseline). A new hidden host sync,
+        non-atomic write, wall-clock deadline, compat bypass, unregistered
+        flag or unguarded mutation fails HERE instead of on TPU."""
+        findings = analysis.run_all()
+        assert findings == [], "\n".join(map(repr, findings))
+
+    def test_cli_main_exits_zero(self):
+        from paddle_tpu.analysis.__main__ import main
+
+        assert main([]) == 0
+
+    def test_baseline_entries_are_justified(self):
+        # load_baseline raises on an entry without a '# why' — reparse the
+        # checked-in file so a drive-by edit can't drop justifications
+        entries = lint_mod.load_baseline(analysis.baseline_path())
+        assert len(entries) >= 1
+
+
+# -- seeded lint violations ---------------------------------------------------
+class TestHostSyncRule:
+    SRC = """
+    def hot(t):
+        return t.numpy()
+    """
+
+    def test_flags_in_hot_scope(self):
+        assert any(f.rule == "host-sync" for f in self._run("core/foo.py"))
+        assert any(f.rule == "host-sync" for f in self._run("distributed/foo.py"))
+        assert any(f.rule == "host-sync" for f in self._run("optimizer/foo.py"))
+
+    def test_silent_outside_hot_scope(self):
+        assert self._run("hapi/foo.py") == []
+        assert self._run("metric/foo.py") == []
+
+    def _run(self, rel):
+        return _lint(self.SRC, rel)
+
+    def test_item_and_raw_buffer_asarray(self):
+        src = """
+        import numpy as np
+        def hot(t):
+            a = t.item()
+            b = np.asarray(t._data)
+            return a, b
+        """
+        rules = [f.rule for f in _lint(src, "core/foo.py")]
+        assert rules.count("host-sync") == 2
+
+    def test_inline_suppression_same_line_and_above(self):
+        src = """
+        def hot(t):
+            a = t.item()  # lint: ok(host-sync)
+            # lint: ok(host-sync)
+            b = t.numpy()
+            return a, b
+        """
+        assert _lint(src, "core/foo.py") == []
+
+
+class TestCompatShimRule:
+    def test_direct_uses_flagged(self):
+        src = """
+        import jax
+        from jax import lax
+        def f(g):
+            jax.shard_map(g)
+            lax.axis_size("dp")
+            return jax.export.export(g)
+        """
+        findings = _lint(src, "distributed/foo.py")
+        assert sum(f.rule == "compat-shim" for f in findings) == 3
+
+    def test_shim_imports_flagged(self):
+        src = """
+        from jax.experimental.shard_map import shard_map
+        from jax.experimental import export
+        from jax import enable_x64
+        """
+        findings = _lint(src, "ops/foo.py")
+        assert sum(f.rule == "compat-shim" for f in findings) == 3
+
+    def test_compat_module_itself_exempt(self):
+        src = "from jax.experimental.shard_map import shard_map\n"
+        assert _lint(src, "core/compat.py") == []
+
+
+class TestAtomicWriteRule:
+    def test_plain_write_flagged(self):
+        src = """
+        import json
+        def save(path, doc):
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        """
+        findings = _lint(src, "distributed/store.py")
+        assert [f.rule for f in findings] == ["atomic-write"]
+
+    def test_tmp_replace_pattern_passes(self):
+        src = """
+        import json, os
+        def save(path, doc):
+            with open(path + ".tmp", "w") as f:
+                json.dump(doc, f)
+            os.replace(path + ".tmp", path)
+        """
+        assert _lint(src, "distributed/store.py") == []
+
+    def test_atomic_open_helper_passes(self):
+        src = """
+        from paddle_tpu.framework.io import atomic_open
+        def save(path, data):
+            with atomic_open(path, "wb") as f:
+                f.write(data)
+        """
+        assert _lint(src, "distributed/store.py") == []
+
+    def test_write_bytes_flagged_append_not(self):
+        src = """
+        def a(p, data):
+            p.write_bytes(data)
+        def b(path, line):
+            with open(path, "a") as f:
+                f.write(line)
+        """
+        findings = _lint(src, "io/foo.py")
+        assert [f.rule for f in findings] == ["atomic-write"]
+        assert findings[0].scope == "a"
+
+
+class TestMonotonicDeadlineRule:
+    def test_direct_deadline_arith_flagged(self):
+        src = """
+        import time
+        def f(timeout_s):
+            deadline = time.time() + timeout_s
+            return deadline
+        """
+        findings = _lint(src, "distributed/foo.py")
+        assert [f.rule for f in findings] == ["monotonic-deadline"]
+
+    def test_tainted_compare_flagged(self):
+        src = """
+        import time
+        def f(t0, timeout_s):
+            now = time.time()
+            if now - t0 > timeout_s:
+                return True
+            return False
+        """
+        findings = _lint(src, "fault/foo.py")
+        assert [f.rule for f in findings] == ["monotonic-deadline"]
+
+    def test_plain_timing_not_flagged(self):
+        src = """
+        import time
+        def f(iters):
+            t0 = time.time()
+            for _ in range(iters):
+                pass
+            return (time.time() - t0) / iters
+        """
+        assert _lint(src, "cost_model/foo.py") == []
+
+    def test_monotonic_passes(self):
+        src = """
+        import time
+        def f(timeout_s):
+            deadline = time.monotonic() + timeout_s
+            return time.monotonic() > deadline
+        """
+        assert _lint(src, "distributed/foo.py") == []
+
+
+class TestBareExceptRule:
+    def test_bare_except_in_commit_path(self):
+        src = """
+        def commit(store):
+            try:
+                store.set("k", "v")
+            except:
+                pass
+        """
+        findings = _lint(src, "fault/retry2.py")
+        assert [f.rule for f in findings] == ["bare-except"]
+
+    def test_base_exception_with_reraise_passes(self):
+        src = """
+        def commit(store):
+            try:
+                store.set("k", "v")
+            except BaseException:
+                store.cleanup()
+                raise
+        """
+        assert _lint(src, "distributed/coord.py") == []
+
+    def test_out_of_scope_module_not_checked(self):
+        src = """
+        def f():
+            try:
+                return 1
+            except:
+                pass
+        """
+        assert _lint(src, "ops/foo.py") == []
+
+
+class TestFlagRegistryRule:
+    def test_unregistered_flag_reported(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        (pkg / "framework").mkdir(parents=True)
+        (pkg / "framework" / "flags.py").write_text(
+            '_FLAGS = {"FLAGS_known": True}\n'
+            "def register_flag(name, default):\n    pass\n"
+        )
+        (pkg / "mod.py").write_text(
+            "from .framework import flags as _flags\n"
+            '_flags.register_flag("FLAGS_runtime_added", 0)\n'
+            'A = _flags.flag("FLAGS_known", True)\n'
+            'B = _flags.flag("FLAGS_runtime_added", 1)\n'
+            'C = _flags.flag("FLAGS_typo_nver_registered", None)\n'
+        )
+        findings = lint_mod.lint_package(str(pkg))
+        bad = [f for f in findings if f.rule == "flag-registry"]
+        assert len(bad) == 1
+        assert "FLAGS_typo_nver_registered" in bad[0].message
+
+    def test_installed_tree_flags_all_registered(self):
+        findings = [
+            f for f in lint_mod.lint_package(analysis.package_root())
+            if f.rule == "flag-registry"
+        ]
+        assert findings == []
+
+
+class TestBaselineGrammar:
+    def test_missing_justification_rejected(self, tmp_path):
+        p = tmp_path / "baseline.txt"
+        p.write_text("host-sync\tcore/foo.py\thot\n")
+        with pytest.raises(ValueError, match="justification"):
+            lint_mod.load_baseline(str(p))
+
+    def test_unknown_rule_rejected(self, tmp_path):
+        p = tmp_path / "baseline.txt"
+        p.write_text("made-up-rule\tcore/foo.py\thot\t# because\n")
+        with pytest.raises(ValueError, match="unknown rule"):
+            lint_mod.load_baseline(str(p))
+
+    def test_baseline_filters_by_scope(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        (pkg / "core").mkdir(parents=True)
+        (pkg / "core" / "foo.py").write_text(
+            "def hot(t):\n    return t.numpy()\n"
+            "def other(t):\n    return t.numpy()\n"
+        )
+        base = [("host-sync", "core/foo.py", "hot")]
+        findings = lint_mod.lint_package(str(pkg), baseline=base)
+        assert [f.scope for f in findings] == ["other"]
+
+
+# -- lock-discipline checker --------------------------------------------------
+class TestLockDiscipline:
+    def test_unguarded_mutations_flagged(self):
+        src = """
+        import threading
+        _lock = threading.Lock()
+        _table = {}  # guarded_by: _lock
+        def bad_set(k, v):
+            _table[k] = v
+        def bad_method():
+            _table.clear()
+        def bad_del(k):
+            del _table[k]
+        """
+        findings = locks_mod.check_source(textwrap.dedent(src), "x.py")
+        assert len(findings) == 3
+        assert all(f.rule == "lock-discipline" for f in findings)
+        assert {f.scope for f in findings} == {"bad_set", "bad_method", "bad_del"}
+
+    def test_with_lock_and_requires_lock_pass(self):
+        src = """
+        import threading
+        _lock = threading.Lock()
+        _table = {}  # guarded_by: _lock
+        def good(k, v):
+            with _lock:
+                _table[k] = v
+        @requires_lock("_lock")
+        def helper(k):
+            _table.pop(k, None)
+        """
+        assert locks_mod.check_source(textwrap.dedent(src), "x.py") == []
+
+    def test_instance_attr_and_init_exemption(self):
+        src = """
+        import threading
+        class T:
+            def __init__(self):
+                self._lk = threading.Lock()
+                self._items = []  # guarded_by: _lk
+                self._items.append(0)  # building, not yet shared
+            def bad(self, x):
+                self._items.append(x)
+            def good(self, x):
+                with self._lk:
+                    self._items.append(x)
+        """
+        findings = locks_mod.check_source(textwrap.dedent(src), "x.py")
+        assert [f.scope for f in findings] == ["T.bad"]
+
+    def test_suppression_applies(self):
+        src = """
+        import threading
+        _lock = threading.Lock()
+        _t = {}  # guarded_by: _lock
+        def startup(v):
+            _t["k"] = v  # lint: ok(lock-discipline)
+        """
+        assert locks_mod.check_source(textwrap.dedent(src), "x.py") == []
+
+    def test_same_attr_name_in_two_classes_keeps_its_own_lock(self):
+        # annotations are keyed by enclosing class: B's _q guarded by _lb
+        # must not be validated against A's _la (or vice versa)
+        src = """
+        import threading
+        class A:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._q = []  # guarded_by: _la
+            def good(self, x):
+                with self._la:
+                    self._q.append(x)
+        class B:
+            def __init__(self):
+                self._lb = threading.Lock()
+                self._q = []  # guarded_by: _lb
+            def bad(self, x):
+                with self._la:  # wrong lock: A's, not B's
+                    self._q.append(x)
+        """
+        findings = locks_mod.check_source(textwrap.dedent(src), "x.py")
+        assert [f.scope for f in findings] == ["B.bad"]
+        assert "_lb" in findings[0].message
+
+    def test_closure_does_not_inherit_enclosing_with_lock(self):
+        # a def inside `with _lock:` is a closure that may run LATER on
+        # another thread — its body must not be treated as lock-held
+        src = """
+        import threading
+        _lock = threading.Lock()
+        _t = {}  # guarded_by: _lock
+        def spawn():
+            with _lock:
+                def worker():
+                    _t["k"] = 1
+                return worker
+        """
+        findings = locks_mod.check_source(textwrap.dedent(src), "x.py")
+        assert [f.scope for f in findings] == ["spawn.worker"]
+
+    def test_annotated_modules_in_tree_are_clean(self):
+        findings = locks_mod.check_lock_discipline(analysis.package_root())
+        assert findings == [], "\n".join(map(repr, findings))
+
+
+# -- runtime ownership assertions (FLAGS_thread_checks) -----------------------
+@pytest.fixture
+def thread_checks_on():
+    flags.set_flags({"FLAGS_thread_checks": True})
+    yield
+    flags.set_flags({"FLAGS_thread_checks": False})
+
+
+class TestThreadChecks:
+    def test_flag_off_is_identity(self):
+        d = {}
+        assert thread_checks.guarded(d, threading.Lock(), "t") is d
+        assert thread_checks.owned(d, "t") is d
+
+    def test_guarded_mutation_requires_lock(self, thread_checks_on):
+        lk = threading.RLock()
+        d = thread_checks.guarded({}, lk, "test.table")
+        with pytest.raises(thread_checks.OwnershipError):
+            d["k"] = 1
+        with lk:
+            d["k"] = 1
+            d.update(z=2)
+            del d["z"]
+        assert d["k"] == 1  # reads never need the lock
+        assert "k" in d and len(d) == 1
+
+    def test_deliberately_racy_mutation_fails_deterministically(
+        self, thread_checks_on
+    ):
+        """The acceptance fixture: two threads, one lock, one of them
+        'forgets' it — the race fails at the mutation site every time, not
+        as a corrupted table later. RLock: ownership (not mere locked-ness)
+        is what makes the verdict deterministic under GIL interleaving."""
+        lk = threading.RLock()
+        table = thread_checks.guarded({}, lk, "racy.table")
+        errors = []
+
+        def disciplined():
+            for i in range(50):
+                with lk:
+                    table[f"d{i}"] = i
+
+        def racy():
+            try:
+                for i in range(50):
+                    table[f"r{i}"] = i  # no lock: must raise on iteration 0
+            except thread_checks.OwnershipError as e:
+                errors.append(e)
+
+        t1 = threading.Thread(target=disciplined)
+        t2 = threading.Thread(target=racy)
+        t1.start(); t2.start(); t1.join(); t2.join()
+        assert len(errors) == 1
+        assert not any(k.startswith("r") for k in table)
+
+    def test_augmented_assignment_on_proxy_checked(self, thread_checks_on):
+        lk = threading.RLock()
+        lst = thread_checks.guarded([1], lk, "aug")
+        st = thread_checks.guarded({1}, lk, "aug-set")
+        with pytest.raises(thread_checks.OwnershipError):
+            lst += [2]
+        with pytest.raises(thread_checks.OwnershipError):
+            st |= {2}
+        with lk:
+            lst += [2]
+            st |= {2}
+        assert list(lst) == [1, 2] and 2 in st
+
+    def test_atomic_open_threads_do_not_share_tmp(self, tmp_path):
+        from paddle_tpu.framework.io import atomic_open
+
+        path = str(tmp_path / "out.json")
+        payloads = [("a" * 4096) + "\n", ("b" * 4096) + "\n"]
+        errs = []
+
+        def write(p):
+            try:
+                for _ in range(20):
+                    with atomic_open(path) as f:
+                        f.write(p)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=write, args=(p,)) for p in payloads]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        content = open(path).read()
+        assert content in payloads  # one COMPLETE write won; never interleaved
+        assert not [n for n in os.listdir(tmp_path) if ".tmp" in n]
+
+    def test_owned_structure_pins_owner_thread(self, thread_checks_on):
+        box = thread_checks.owned([0], "counter")
+        box[0] += 1  # this thread becomes the owner
+        caught = []
+
+        def foreign():
+            try:
+                box[0] += 1
+            except thread_checks.OwnershipError as e:
+                caught.append(e)
+
+        t = threading.Thread(target=foreign)
+        t.start(); t.join()
+        assert len(caught) == 1 and "owned by" in str(caught[0])
+        assert box[0] == 1
+
+    def test_requires_lock_decorator_asserts(self, thread_checks_on):
+        lk = threading.RLock()
+
+        @thread_checks.requires_lock(lk, name="lk")
+        def helper(d):
+            d["x"] = 1
+
+        with pytest.raises(thread_checks.OwnershipError):
+            helper({})
+        with lk:
+            d = {}
+            helper(d)
+        assert d == {"x": 1}
+
+    def test_watchdog_tables_wrapped_under_flag(self, thread_checks_on, tmp_path):
+        from paddle_tpu.distributed import watchdog
+
+        watchdog.reset()
+        try:
+            watchdog.configure(rank=0, world_size=1, store=None,
+                               progress_dir=str(tmp_path))
+            # publish goes through the lock internally: fine
+            watchdog.publish(step=1, phase="test", force=True)
+            assert watchdog.local_progress()["step"] == 1
+            # an unguarded direct mutation of the shared table raises
+            with pytest.raises(thread_checks.OwnershipError):
+                watchdog._guards[99] = (0.0, "rogue")
+        finally:
+            watchdog.reset()
+
+    def test_device_prefetcher_consumer_ownership(self, thread_checks_on):
+        from paddle_tpu.io import DevicePrefetcher
+
+        p = DevicePrefetcher(iter([np.zeros((2, 2), np.float32)]))
+        try:
+            batch = next(p)  # main thread becomes the consumer/owner
+            assert tuple(batch.shape) == (2, 2)
+            caught = []
+
+            def foreign():
+                try:
+                    p._consumed[0] += 1
+                except thread_checks.OwnershipError as e:
+                    caught.append(e)
+
+            t = threading.Thread(target=foreign)
+            t.start(); t.join()
+            assert len(caught) == 1
+        finally:
+            p.close()
+
+
+# -- entry-point ergonomics ---------------------------------------------------
+class TestCLI:
+    def test_no_baseline_reports_grandfathered(self, capsys):
+        from paddle_tpu.analysis.__main__ import main
+
+        rc = main(["--no-baseline", "--no-selfcheck"])
+        out = capsys.readouterr().out
+        assert rc == 1  # the grandfathered funnel findings resurface
+        assert "host-sync" in out and "baseline" in out
+
+    def test_selfcheck_rejects_seeded_cycle(self):
+        from paddle_tpu.analysis.__main__ import _verifier_selfcheck
+
+        assert _verifier_selfcheck() == 0
