@@ -849,6 +849,94 @@ def bench_host_embedding(paddle, jax, np, on_tpu):
     }
 
 
+def bench_serving(paddle, jax, np, on_tpu):
+    """Serving-engine load generator (ROADMAP item 1): >= 64 concurrent
+    autoregressive streams through the continuous-batching + paged-KV engine
+    on a tiny GPT, submitted from client threads. Prints ONE `SERVE_PERF`
+    JSON line (p50/p99 request latency, generated tokens/sec, mean decode
+    batch occupancy, compile count) and returns the same dict for
+    extra_metrics."""
+    import threading
+
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import Engine
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=8192, hidden_size=256, num_layers=4,
+                        num_heads=4, max_position_embeddings=2048,
+                        hidden_dropout=0.0, attention_dropout=0.0)
+        streams, max_new, lo, hi = 256, 64, 16, 256
+        ekw = dict(block_size=16, num_blocks=8192, max_batch=128,
+                   max_seq_len=1024)
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                        num_heads=2, max_position_embeddings=256,
+                        hidden_dropout=0.0, attention_dropout=0.0)
+        streams, max_new, lo, hi = 64, 8, 4, 32
+        ekw = dict(block_size=16, num_blocks=512, max_batch=64,
+                   max_seq_len=128)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (int(rng.randint(lo, hi)),)).tolist()
+               for _ in range(streams)]
+
+    with Engine(model, **ekw) as eng:
+        # warm EVERY bucket executable the timed wave will touch (all prefill
+        # length buckets + every decode width the drain passes through) with
+        # an untimed wave of the same prompts, so the timed window measures
+        # serving, not compilation — the "warm cache" the compile-count
+        # promise is about
+        warm = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        [h.result(timeout=600) for h in warm]
+        handles = [None] * streams
+        clients = 8
+        client_errs = []
+
+        def client(cid):
+            try:
+                for i in range(cid, streams, clients):
+                    handles[i] = eng.submit(prompts[i], max_new_tokens=max_new)
+            except Exception as e:  # surface the REAL failure, not a None handle
+                client_errs.append(e)
+
+        from paddle_tpu import profiler as _prof
+
+        c0 = _prof.counters()
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=client, args=(c,)) for c in range(clients)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        if client_errs:
+            raise client_errs[0]
+        outs = [h.result(timeout=600) for h in handles]
+        wall = time.monotonic() - t0
+        c1 = _prof.counters()
+        lat = sorted(h.latency_s for h in handles)
+        st = eng.stats()
+
+    gen_tokens = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+    assert all(len(o) == len(p) + max_new for o, p in zip(outs, prompts))
+    # occupancy over the TIMED window only (counter deltas) — the engine's
+    # lifetime mean would dilute it with the warm wave's ramp/drain
+    d_live = c1.get("serve_occupancy_live", 0) - c0.get("serve_occupancy_live", 0)
+    d_slots = c1.get("serve_occupancy_slots", 0) - c0.get("serve_occupancy_slots", 0)
+    line = {
+        "name": f"serving load-gen (GPT h{cfg.hidden_size}xL{cfg.num_layers}, "
+                f"{streams} streams, max_new {max_new})",
+        "streams": streams,
+        "tokens_per_sec": round(gen_tokens / wall, 1),
+        "p50_latency_s": round(lat[len(lat) // 2], 3),
+        "p99_latency_s": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+        "batch_occupancy_mean": round(d_live / max(d_slots, 1), 4),
+        "compiles": st["compiles"],
+        "wall_s": round(wall, 2),
+    }
+    print("SERVE_PERF " + json.dumps(line))
+    return line
+
+
 def main():
     t_start = time.time()
     import numpy as np
@@ -878,7 +966,7 @@ def main():
                bench_verify_overhead,
                bench_gpt_1p3b, bench_gpt_8k_flash,
                bench_vit_l_aot, bench_yolov3_aot, bench_llama_1b,
-               bench_dp8_gpt, bench_host_embedding):
+               bench_dp8_gpt, bench_serving, bench_host_embedding):
         if remaining() < 30.0:
             extras.append({"name": fn.__name__, "skipped": "budget"})
             continue

@@ -83,6 +83,19 @@ _FLAGS: Dict[str, object] = {
     # profiler's live_bytes/peak gauges and lazy_flush span attrs) without a
     # running Profiler; Profiler(profile_memory=True) turns it on per session.
     "FLAGS_profile_memory": False,
+    # Serving engine defaults (paddle_tpu/serving/ — continuous batching +
+    # paged KV cache): KV block size in tokens, total preallocated blocks in
+    # the pool (block 0 is the reserved trash block), the decode batch-width
+    # ceiling (bucketed in powers of two up to this), the fixed prefill
+    # batch width, the per-sequence length cap (clamped to the model's
+    # max_position_embeddings), and the weight-only int8 serving path.
+    # EngineConfig fields override per engine.
+    "FLAGS_serve_block_size": 16,
+    "FLAGS_serve_num_blocks": 512,
+    "FLAGS_serve_max_batch": 64,
+    "FLAGS_serve_prefill_batch": 4,
+    "FLAGS_serve_max_seq_len": 2048,
+    "FLAGS_serve_int8": False,
     # JAX persistent compilation cache (warm executable starts across
     # processes). Dir defaults to ~/.cache/paddle_tpu/xla when unset.
     "FLAGS_xla_persistent_cache": True,

@@ -124,7 +124,8 @@ class PredictorTensor:
     def copy_from_cpu(self, data: np.ndarray):
         if not self._is_input:
             raise RuntimeError(f"'{self.name}' is an output handle")
-        self._owner._inputs[self._index] = np.ascontiguousarray(data)
+        with self._owner._lock:
+            self._owner._inputs[self._index] = np.ascontiguousarray(data)
 
     def share_external_data(self, data):
         # zero-copy: a device-resident (jax) array is used as-is — no
@@ -132,7 +133,8 @@ class PredictorTensor:
         if not self._is_input:
             raise RuntimeError(f"'{self.name}' is an output handle")
         if hasattr(data, "devices") or hasattr(data, "_data"):
-            self._owner._inputs[self._index] = getattr(data, "_data", data)
+            with self._owner._lock:
+                self._owner._inputs[self._index] = getattr(data, "_data", data)
         else:
             self.copy_from_cpu(np.asarray(data))
 
@@ -164,6 +166,13 @@ class Predictor:
     The compiled executable (PJRT) is shared by reference across clones; each
     clone has its own input/output slots, so per-thread use is race-free —
     the same contract as AnalysisPredictor::Clone (analysis_predictor.cc).
+
+    A SINGLE predictor instance shared by concurrent callers is also safe
+    for the list API (``run(inputs)`` stages, executes and returns under one
+    ``_lock`` hold, serializing callers); the named-handle protocol
+    (``copy_from_cpu`` → ``run()`` → ``copy_to_cpu``) spans multiple calls,
+    so interleaved threads can still overwrite each other's slots — use
+    ``clone()`` per thread (or the list API) for concurrency.
     """
 
     def __init__(self, config: Config, _shared=None):
@@ -173,9 +182,9 @@ class Predictor:
              self._output_names, self._n_outputs) = _shared
         else:
             self._load(config)
-        self._inputs: List[Optional[np.ndarray]] = [None] * len(self._input_names)
-        self._outputs = None
         self._lock = threading.Lock()
+        self._inputs: List[Optional[np.ndarray]] = [None] * len(self._input_names)  # guarded_by: _lock
+        self._outputs = None  # guarded_by: _lock
 
     def _load(self, config: Config):
         import jax
@@ -261,7 +270,8 @@ class Predictor:
         return Predictor(self._config, _shared=shared)
 
     def clear_intermediate_tensor(self):
-        self._outputs = None
+        with self._lock:
+            self._outputs = None
 
     def try_shrink_memory(self):
         pass
@@ -269,6 +279,15 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def create_serving_engine(model, **kw):
+    """Autoregressive serving front door (continuous batching + paged KV
+    cache): a thin re-export of :class:`paddle_tpu.serving.Engine`, imported
+    lazily so the deployment namespace stays cheap for Predictor-only use."""
+    from ..serving import Engine
+
+    return Engine(model, **kw)
 
 
 # Legacy aliases (reference paddle.inference exports)

@@ -28,6 +28,21 @@ from ..core.tensor import Tensor
 
 _DECODE_CACHE = {}
 
+# Steps actually executed by the most recent non-beam generate() call: the
+# eos early-exit while_loop stops as soon as every row is finished, so this
+# is < max_new_tokens whenever eos cut the batch short (diagnostic). Holds
+# the still-dispatched jax scalar (or a plain int on the beam path).
+_LAST_DECODE_STEPS = None
+
+
+def last_decode_steps() -> Optional[int]:
+    """Trip count of the most recent ``generate``/``generate_llama`` decode
+    loop on this process (None before the first call). Not thread-safe —
+    a diagnostic for tests and telemetry, not an API. The host-blocking
+    coercion happens HERE, not in generate(), so the decode dispatch stays
+    asynchronous for callers that never ask."""
+    return None if _LAST_DECODE_STEPS is None else int(_LAST_DECODE_STEPS)
+
 
 def top_k_top_p_filtering(logits, top_k=0, top_p=1.0):
     """Mask logits outside top-k / nucleus top-p (reference top_k_op +
@@ -91,6 +106,38 @@ def _gpt_arch(H, D):
     def embed_token(params, tok, pos):
         return params["wte"][tok][:, None] + params["wpe"][pos][None, None]
 
+    def embed_rows(params, toks, pos):
+        # packed decode: one token per row at per-row absolute positions —
+        # toks (B,), pos (B,) -> (B, 1, H·D)
+        return params["wte"][toks][:, None] + params["wpe"][pos][:, None]
+
+    def head_rows(params, x, idx):
+        # logits at each row's own position (per-row prompt lengths): the
+        # batch-packed analogue of head()'s x[:, -1]
+        h = _ln(x, params["lnf_w"], params["lnf_b"])
+        rows = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
+        return rows @ params["wte"].T
+
+    def block_rows(w, x, k_ctx, v_ctx, live, pos):
+        # single-token decode against a GATHERED paged context: x (B,1,H·D);
+        # k_ctx/v_ctx (B,Tp,KV,D) hold each row's blocks in sequence order
+        # with a stale slot at pos that the fresh k/v overwrites in-ctx;
+        # live (B,Tp) masks positions <= pos. The caller owns scattering
+        # (k_new, v_new) back into the pool for future steps.
+        B = x.shape[0]
+        rows = jnp.arange(B)
+        h = _ln(x, w["ln1_w"], w["ln1_b"])
+        qkv = (h @ w["qkv_w"] + w["qkv_b"]).reshape(B, 1, 3, H, D)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_new, v_new = k[:, 0], v[:, 0]
+        kc = k_ctx.at[rows, pos].set(k_new)
+        vc = v_ctx.at[rows, pos].set(v_new)
+        o = _grouped_attention(q, kc, vc, live[:, None, None, None, :], rep=1)
+        x = x + (o @ w["proj_w"] + w["proj_b"])
+        h2 = _ln(x, w["ln2_w"], w["ln2_b"])
+        ff = jax.nn.gelu(h2 @ w["up_w"] + w["up_b"], approximate=True) @ w["down_w"] + w["down_b"]
+        return x + ff, k_new, v_new
+
     def block(w, x, kv=None, pos=None):
         B, T = x.shape[0], x.shape[1]
         h = _ln(x, w["ln1_w"], w["ln1_b"])
@@ -116,6 +163,8 @@ def _gpt_arch(H, D):
         return x[:, -1] @ params["wte"].T  # tied head
 
     return {"embed_prompt": embed_prompt, "embed_token": embed_token,
+            "embed_rows": embed_rows, "head_rows": head_rows,
+            "block_rows": block_rows,
             "block": block, "head": head, "kv_heads": H, "head_dim": D}
 
 
@@ -155,6 +204,20 @@ def _rope_at(x, pos0, theta):
     return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
 
 
+def _rope_rows(x, pos, theta):
+    """Rotary embedding for ONE token per row at per-row absolute positions
+    (packed decode): x (B, 1, H, D), pos (B,) int."""
+    D = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    ang = pos.astype(jnp.float32)[:, None] * inv[None, :]  # (B, D/2)
+    cos = jnp.cos(ang)[:, None, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, None, None, :].astype(x.dtype)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+
 def _llama_arch(H, KV, D, theta, eps):
     rep = H // KV
 
@@ -163,6 +226,34 @@ def _llama_arch(H, KV, D, theta, eps):
 
     def embed_token(params, tok, pos):
         return params["wte"][tok][:, None]
+
+    def embed_rows(params, toks, pos):
+        return params["wte"][toks][:, None]
+
+    def head_rows(params, x, idx):
+        h = _rms(x, params["lnf_w"], eps)
+        rows = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
+        return rows @ params["head_w"]
+
+    def block_rows(w, x, k_ctx, v_ctx, live, pos):
+        # see the GPT plug for the contract; RoPE applied at each row's own
+        # absolute position, GQA against the un-repeated gathered cache
+        B = x.shape[0]
+        rows = jnp.arange(B)
+        h = _rms(x, w["ln1_w"], eps)
+        q = (h @ w["q_w"]).reshape(B, 1, H, D)
+        k = (h @ w["k_w"]).reshape(B, 1, KV, D)
+        v = (h @ w["v_w"]).reshape(B, 1, KV, D)
+        q = _rope_rows(q, pos, theta)
+        k = _rope_rows(k, pos, theta)
+        k_new, v_new = k[:, 0], v[:, 0]
+        kc = k_ctx.at[rows, pos].set(k_new)
+        vc = v_ctx.at[rows, pos].set(v_new)
+        o = _grouped_attention(q, kc, vc, live[:, None, None, None, :], rep)
+        x = x + o @ w["o_w"]
+        h2 = _rms(x, w["ln2_w"], eps)
+        ff = (jax.nn.silu(h2 @ w["gate_w"]) * (h2 @ w["up_w"])) @ w["down_w"]
+        return x + ff, k_new, v_new
 
     def block(w, x, kv=None, pos=None):
         B, T = x.shape[0], x.shape[1]
@@ -192,6 +283,8 @@ def _llama_arch(H, KV, D, theta, eps):
         return _rms(x, params["lnf_w"], eps)[:, -1] @ params["head_w"]
 
     return {"embed_prompt": embed_prompt, "embed_token": embed_token,
+            "embed_rows": embed_rows, "head_rows": head_rows,
+            "block_rows": block_rows,
             "block": block, "head": head, "kv_heads": KV, "head_dim": D}
 
 
@@ -217,7 +310,12 @@ def _build_decode(arch, T0, T_max, max_new_tokens, temperature, top_k, top_p,
             caches.append((kc, vc))
         logits0 = arch["head"](params, x)
 
-        out = jnp.zeros((B, T_max), jnp.int32).at[:, :T0].set(ids)
+        # Tail pre-filled with eos: a finished row's remaining slots already
+        # hold the pad value, so its writes below are no-ops (live-row
+        # freeze) and the while_loop can exit as soon as EVERY row is done
+        # instead of burning steps to max_new_tokens.
+        fill = 0 if eos_token_id is None else int(eos_token_id)
+        out = jnp.full((B, T_max), fill, jnp.int32).at[:, :T0].set(ids)
         finished = jnp.zeros((B,), bool)
 
         def sample_from(logits, key):
@@ -227,28 +325,40 @@ def _build_decode(arch, T0, T_max, max_new_tokens, temperature, top_k, top_p,
                 return jax.random.categorical(key, logits, axis=-1)
             return jnp.argmax(logits, axis=-1)
 
-        def step(i, carry):
-            out, caches, finished, key, logits = carry
+        def step(carry):
+            i, out, caches, finished, key, logits = carry
             key, sub = jax.random.split(key)
             nxt = sample_from(logits, sub).astype(jnp.int32)
             if eos_token_id is not None:
+                # frozen rows re-write the eos their slot already holds
                 nxt = jnp.where(finished, eos_token_id, nxt)
                 finished = finished | (nxt == eos_token_id)
             pos = T0 + i
-            out = lax.dynamic_update_slice(out, nxt[:, None], (0, pos))
+            out = lax.dynamic_update_slice(
+                out, nxt[:, None], (jnp.asarray(0, pos.dtype), pos)
+            )
             x = arch["embed_token"](params, nxt, pos)
             new_caches = []
             for w, kv in zip(layer_ws, caches):
                 x, kv = arch["block"](w, x, kv=kv, pos=pos)
                 new_caches.append(kv)
             logits = arch["head"](params, x)
-            return out, tuple(new_caches), finished, key, logits
+            return i + 1, out, tuple(new_caches), finished, key, logits
 
-        out, _, _, _, _ = lax.fori_loop(
-            0, max_new_tokens, step,
-            (out, tuple(caches), finished, key, logits0),
+        def cond(carry):
+            i, _, _, finished, _, _ = carry
+            live = i < max_new_tokens
+            if eos_token_id is not None:
+                live = live & ~jnp.all(finished)
+            return live
+
+        steps, out, _, _, _, _ = lax.while_loop(
+            cond, step,
+            # default int dtype (x64-dependent) so `pos = T0 + i` matches the
+            # literal indices inside arch["block"]'s dynamic_update_slice
+            (jnp.asarray(0), out, tuple(caches), finished, key, logits0),
         )
-        return out
+        return out, steps
 
     return decode
 
@@ -344,6 +454,7 @@ def _build_beam_decode(arch, T0, T_max, max_new_tokens, num_beams, eos_token_id,
 
 def _run(arch_key, arch, params, ids_in, T0, max_new_tokens, temperature,
          top_k, top_p, eos_token_id, do_sample, num_beams=1, length_penalty=0.0):
+    global _LAST_DECODE_STEPS
     B = ids_in.shape[0]
     T_max = T0 + int(max_new_tokens)
     key = random_state.next_key()
@@ -356,6 +467,7 @@ def _run(arch_key, arch, params, ids_in, T0, max_new_tokens, temperature,
                 arch, T0, T_max, int(max_new_tokens), int(num_beams),
                 eos_token_id, float(length_penalty)))
             _DECODE_CACHE[cache_key] = fn
+        _LAST_DECODE_STEPS = int(max_new_tokens)  # beam loop has no early exit
         return Tensor(fn(params, ids_in, key), stop_gradient=True)
     cache_key = arch_key + (B, T0, int(max_new_tokens), float(temperature),
                             int(top_k), float(top_p), eos_token_id,
@@ -366,7 +478,9 @@ def _run(arch_key, arch, params, ids_in, T0, max_new_tokens, temperature,
             arch, T0, T_max, int(max_new_tokens), float(temperature),
             int(top_k), float(top_p), eos_token_id, bool(do_sample)))
         _DECODE_CACHE[cache_key] = fn
-    return Tensor(fn(params, ids_in, key), stop_gradient=True)
+    out, steps = fn(params, ids_in, key)
+    _LAST_DECODE_STEPS = steps  # dispatched jax scalar; coerced on read
+    return Tensor(out, stop_gradient=True)
 
 
 @no_grad()
@@ -385,19 +499,53 @@ def generate(
     """Sample continuations for a GPTForPretraining-style model. Returns
     (B, T_prompt + max_new_tokens) int ids (generation stops writing after
     eos but shapes stay static — XLA-friendly)."""
+    arch_key, arch, params, max_pos = gpt_decode_state(model)
+    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    T0 = ids.shape[1]
+    if T0 + int(max_new_tokens) > max_pos:
+        raise ValueError(
+            f"generate: {T0 + int(max_new_tokens)} exceeds "
+            f"max_position_embeddings {max_pos}"
+        )
+    return _run(arch_key, arch, params, ids, T0, max_new_tokens,
+                temperature, top_k, top_p, eos_token_id, do_sample,
+                num_beams=num_beams, length_penalty=length_penalty)
+
+
+@no_grad()
+def generate_llama(
+    model, input_ids, max_new_tokens=32, temperature=1.0, top_k=0, top_p=1.0,
+    eos_token_id=None, do_sample=True,
+):
+    """KV-cached compiled decode for LlamaForCausalLM: RoPE applied at
+    absolute cache positions; GQA attends against the un-repeated KV cache."""
+    arch_key, arch, params, max_pos = llama_decode_state(model)
+    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    T0 = ids.shape[1]
+    if T0 + int(max_new_tokens) > max_pos:
+        raise ValueError("generate: length exceeds max_position_embeddings")
+    return _run(arch_key, arch, params, ids, T0, max_new_tokens,
+                temperature, top_k, top_p, eos_token_id, do_sample)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler-drivable decode state + paged prefill/step programs
+# ---------------------------------------------------------------------------
+# The serving engine (paddle_tpu/serving/) drives these directly: the state
+# extractors are the single weight-tree + arch-plug extraction point shared
+# with generate(), and the builders return batch-packed, cache-position-
+# explicit pure functions the engine jits per bucket shape.
+
+def gpt_decode_state(model):
+    """(arch_key, arch, params, max_positions) for a GPTForPretraining-style
+    model — the extraction point shared by ``generate()`` and the serving
+    engine's paged prefill/decode programs."""
     gpt = model.gpt
     cfg = model.config
     H = cfg.num_heads
     D = cfg.hidden_size // H
-
-    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
-    ids = ids.astype(jnp.int32)
-    T0 = ids.shape[1]
-    if T0 + int(max_new_tokens) > cfg.max_position_embeddings:
-        raise ValueError(
-            f"generate: {T0 + int(max_new_tokens)} exceeds "
-            f"max_position_embeddings {cfg.max_position_embeddings}"
-        )
     qkv_w = gpt.layers[0].attn.qkv.weight._data
     if qkv_w.shape[-1] != 3 * cfg.hidden_size:
         raise NotImplementedError(
@@ -414,32 +562,18 @@ def generate(
         "layers": [_gpt_layer_weights(l) for l in gpt.layers],
     }
     arch_key = ("gpt", H, D, len(params["layers"]))
-    return _run(arch_key, _gpt_arch(H, D), params, ids, T0, max_new_tokens,
-                temperature, top_k, top_p, eos_token_id, do_sample,
-                num_beams=num_beams, length_penalty=length_penalty)
+    return arch_key, _gpt_arch(H, D), params, cfg.max_position_embeddings
 
 
-@no_grad()
-def generate_llama(
-    model, input_ids, max_new_tokens=32, temperature=1.0, top_k=0, top_p=1.0,
-    eos_token_id=None, do_sample=True,
-):
-    """KV-cached compiled decode for LlamaForCausalLM: RoPE applied at
-    absolute cache positions; GQA attends against the un-repeated KV cache."""
+def llama_decode_state(model):
+    """(arch_key, arch, params, max_positions) for LlamaForCausalLM."""
     cfg = model.model.config
     H = cfg.num_heads
     KV = cfg.kv_heads
     D = cfg.hidden_size // H
-
-    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
-    ids = ids.astype(jnp.int32)
-    T0 = ids.shape[1]
-    if T0 + int(max_new_tokens) > cfg.max_position_embeddings:
-        raise ValueError("generate: length exceeds max_position_embeddings")
     q_w = model.model.layers[0].self_attn.q_proj.weight._data
     if q_w.shape[-1] != cfg.hidden_size:
         raise NotImplementedError("generate: physically mp-sharded weights")
-
     params = {
         "wte": model.model.embed_tokens.weight._data,
         "lnf_w": model.model.norm.weight._data,
@@ -450,5 +584,80 @@ def generate_llama(
     arch_key = ("llama", H, KV, D, len(params["layers"]),
                 float(cfg.rope_theta), float(cfg.rms_norm_eps))
     arch = _llama_arch(H, KV, D, float(cfg.rope_theta), float(cfg.rms_norm_eps))
-    return _run(arch_key, arch, params, ids, T0, max_new_tokens,
-                temperature, top_k, top_p, eos_token_id, do_sample)
+    return arch_key, arch, params, cfg.max_position_embeddings
+
+
+def build_paged_prefill(arch, B, T_bucket, block_size, max_blocks):
+    """Compiled prompt prefill over a length-bucketed batch, writing KV into
+    the paged pool.
+
+    The returned pure fn ``prefill(params, ids, lens, tables, kpool, vpool)``
+    runs the dense causal forward over ``ids`` (B, T_bucket) — causality
+    makes the K/V of every REAL position exact regardless of the padding
+    behind it — reshapes each layer's (B, T_bucket, KV, D) K/V into
+    ``T_bucket // block_size`` blocks and scatters them at ``tables[:, :nb]``
+    (rows shorter than the bucket point their tail entries at the reserved
+    trash block 0), and returns ``(kpool, vpool, logits)`` with logits taken
+    at each row's true last prompt token (``lens - 1``)."""
+    KV, D = arch["kv_heads"], arch["head_dim"]
+    if T_bucket % block_size:
+        raise ValueError(
+            f"prefill bucket {T_bucket} must be a multiple of block_size "
+            f"{block_size}"
+        )
+    nb = T_bucket // block_size
+    if nb > max_blocks:
+        raise ValueError("prefill bucket exceeds max sequence blocks")
+
+    def prefill(params, ids, lens, tables, kpool, vpool):
+        layer_ws = params["layers"]
+        x = arch["embed_prompt"](params, ids, T_bucket)
+        tb = tables[:, :nb]
+        for li, w in enumerate(layer_ws):
+            x, (k, v) = arch["block"](w, x)
+            kpool = kpool.at[li, tb].set(k.reshape(B, nb, block_size, KV, D))
+            vpool = vpool.at[li, tb].set(v.reshape(B, nb, block_size, KV, D))
+        logits = arch["head_rows"](params, x, lens - 1)
+        return kpool, vpool, logits
+
+    return prefill
+
+
+def build_paged_decode(arch, B, block_size, max_blocks):
+    """One packed continuous-batching decode step over the paged KV cache.
+
+    The returned pure fn
+    ``step(params, kpool, vpool, tables, pos, toks, temps, key)`` feeds one
+    token per row (``toks`` at per-row write positions ``pos``), gathers each
+    row's context from its block table (``kpool[l][tables]`` — the
+    gather-based paged attention read), overwrites the slot at ``pos`` with
+    the fresh K/V in-context, masks positions ``> pos`` (per-row live
+    lengths), scatters the new K/V back into the pool for future steps, and
+    returns ``(kpool, vpool, next_tokens)``. Rows with ``temps > 0`` sample
+    at that temperature (one PRNG key per step — not replay-stable across
+    batch compositions); rows at 0 are greedy. Dead/padding rows should
+    point their tables at the trash block with ``pos = 0``; their outputs
+    are garbage the scheduler ignores."""
+    KV, D = arch["kv_heads"], arch["head_dim"]
+    T_pad = block_size * max_blocks
+
+    def step(params, kpool, vpool, tables, pos, toks, temps, key):
+        layer_ws = params["layers"]
+        x = arch["embed_rows"](params, toks, pos)
+        bids = jnp.take_along_axis(tables, (pos // block_size)[:, None], axis=1)[:, 0]
+        offs = pos % block_size
+        live = jnp.arange(T_pad)[None, :] <= pos[:, None]
+        for li, w in enumerate(layer_ws):
+            k_ctx = kpool[li][tables].reshape(B, T_pad, KV, D)
+            v_ctx = vpool[li][tables].reshape(B, T_pad, KV, D)
+            x, k_new, v_new = arch["block_rows"](w, x, k_ctx, v_ctx, live, pos)
+            kpool = kpool.at[li, bids, offs].set(k_new)
+            vpool = vpool.at[li, bids, offs].set(v_new)
+        logits = arch["head"](params, x)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = (logits / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.float32)
+        sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(temps > 0, sampled, greedy)
+        return kpool, vpool, nxt
+
+    return step

@@ -109,6 +109,21 @@ def counters() -> Dict[str, int]:
     ``dp_reduce_scatters`` / ``dp_all_reduces`` (collective launches), and
     ``wus_enabled`` (1 when the engine runs the sharded weight update).
 
+    Serving engine (paddle_tpu/serving/): ``serve_requests`` /
+    ``serve_admitted`` / ``serve_retired`` / ``serve_cancelled`` /
+    ``serve_failed`` (request lifecycle), ``serve_prefills`` /
+    ``serve_decode_steps`` / ``serve_tokens`` (work done),
+    ``serve_compiles`` (bucket programs built — bounded by the bucket
+    count), ``serve_pages_allocated`` / ``serve_pages_freed`` (KV block
+    pool churn), ``serve_backpressure`` (admissions stalled on pool
+    exhaustion), ``serve_preempted`` (sequences evicted for re-prefill),
+    ``serve_occupancy_live`` / ``serve_occupancy_slots`` (live rows vs
+    padded batch slots per decode step — their ratio is mean batch
+    occupancy), and ``serve_engine_errors``. Live gauges (queue depth,
+    page-pool utilization, in-flight request table) come from
+    ``Engine.stats()`` and ride every flight-recorder dump via the
+    engine's context provider.
+
     Telemetry: ``flight_dumps`` (flight-recorder post-mortems written by
     this process).
 

@@ -1,0 +1,28 @@
+"""paddle_tpu.serving — production inference: continuous batching + paged KV
+cache over the compiled decode programs in ``models/generation.py``.
+
+Front door::
+
+    from paddle_tpu.serving import Engine
+
+    with Engine(model, block_size=16, num_blocks=512, max_batch=64) as eng:
+        h = eng.submit(prompt_ids, max_new_tokens=64, eos_token_id=eos,
+                       stream=True)
+        for tok in h:          # streaming
+            ...
+        ids = h.result()       # or blocking; h.cancel() mid-stream
+
+See serving/engine.py for the scheduler, serving/pool.py for the paged KV
+block allocator, serving/int8.py for the weight-only int8 path, and the
+README "Serving" section for bucketing, backpressure and cancellation
+semantics.
+"""
+from .engine import (  # noqa: F401
+    Engine, EngineConfig, RequestCancelled, RequestHandle, ServeError,
+)
+from .pool import PagePool, TRASH_BLOCK  # noqa: F401
+
+__all__ = [
+    "Engine", "EngineConfig", "RequestHandle", "ServeError",
+    "RequestCancelled", "PagePool", "TRASH_BLOCK",
+]

@@ -1,0 +1,792 @@
+"""Serving engine — continuous batching + paged KV cache over compiled decode.
+
+The inference stack's Predictor serves one fixed-shape request at a time;
+real traffic is many concurrent autoregressive streams of ragged lengths.
+This engine is the production front door over the scheduler-drivable decode
+programs in ``models/generation.py``:
+
+* **async request queue + continuous batching** — ``submit()`` enqueues from
+  any thread; a dedicated engine thread admits and retires sequences EVERY
+  decode step (a finished stream's slot is refilled next step, not at the
+  end of a static batch), so batch occupancy tracks offered load;
+* **bucketed batch shapes** — prompts prefill in length buckets (powers of
+  two in block units) at a fixed prefill batch width, decode runs at the
+  smallest power-of-two batch width covering the live set; each bucket jits
+  ONCE per engine (``serve_compiles``) and warm executables reuse the
+  persistent compilation cache across processes (PR 1);
+* **paged KV cache** — fixed-size KV blocks in a preallocated pool, a
+  per-sequence block table, gather-based paged attention reads
+  (``build_paged_decode``), so HBM holds ``Σ ceil(len/block)`` blocks
+  instead of ``B × T_max`` dense caches. Pool exhaustion is backpressure:
+  admission stalls the queue, and a running sequence that can't grow evicts
+  the youngest peer (freed blocks, state requeued for re-prefill from its
+  accumulated tokens) rather than failing anything;
+* **prefill/decode phase separation** — prompt prefill is a dense causal
+  pass batched by length bucket; decode is one packed batch with per-row
+  positions and live masks;
+* **int8 serving** (``int8=True``) — weight-only int8 via the PTQ rounding
+  (serving/int8.py), dequantized inside the compiled programs.
+
+Every scheduler action is a profiler span (``admit``/``schedule``/
+``prefill``/``decode_step``/``page_alloc``/``evict``) with ``serve_*``
+counters, and the engine registers a flight-recorder context provider so
+crash dumps carry the in-flight request table.
+"""
+from __future__ import annotations
+
+import collections
+import copy
+import itertools
+import queue as _queue
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import flags
+from ..profiler import counter_inc, flight
+from ..profiler.spans import span
+from .pool import PagePool, TRASH_BLOCK
+
+__all__ = [
+    "Engine", "EngineConfig", "RequestHandle", "ServeError",
+    "RequestCancelled",
+]
+
+_engine_ids = itertools.count(1)
+
+
+class ServeError(RuntimeError):
+    pass
+
+
+class RequestCancelled(ServeError):
+    pass
+
+
+class EngineConfig:
+    """Serving knobs. ``None`` fields resolve from the ``FLAGS_serve_*``
+    registry at engine construction, so fleet-wide defaults are one
+    ``set_flags`` away while tests override per-engine."""
+
+    def __init__(self, block_size=None, num_blocks=None, max_batch=None,
+                 max_seq_len=None, prefill_batch=None, int8=None,
+                 decode_buckets=None, seed=0):
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len
+        self.prefill_batch = prefill_batch
+        self.int8 = int8
+        self.decode_buckets = decode_buckets
+        self.seed = seed
+
+    def resolve(self, model_max_positions: int) -> "EngineConfig":
+        def pick(v, name):
+            # explicit 0 must reach validation, not silently fall back
+            return int(v if v is not None else flags.flag(name))
+
+        self.block_size = pick(self.block_size, "FLAGS_serve_block_size")
+        self.num_blocks = pick(self.num_blocks, "FLAGS_serve_num_blocks")
+        self.max_batch = pick(self.max_batch, "FLAGS_serve_max_batch")
+        self.prefill_batch = pick(self.prefill_batch, "FLAGS_serve_prefill_batch")
+        max_seq = pick(self.max_seq_len, "FLAGS_serve_max_seq_len")
+        self.max_seq_len = min(max_seq, int(model_max_positions))
+        if self.int8 is None:
+            self.int8 = bool(flags.flag("FLAGS_serve_int8", False))
+        if self.block_size < 1 or self.num_blocks < 2 or self.max_batch < 1 \
+                or self.prefill_batch < 1 or self.max_seq_len < 1:
+            raise ValueError(
+                "serving: block_size/max_batch/prefill_batch/max_seq_len "
+                ">= 1 and num_blocks >= 2 required"
+            )
+        if self.decode_buckets is None:
+            b, buckets = 1, []
+            while b < self.max_batch:
+                buckets.append(b)
+                b *= 2
+            self.decode_buckets = tuple(buckets) + (self.max_batch,)
+        else:
+            # drop widths past the ceiling, keep ascending order, and make
+            # sure max_batch itself is present so every live set has a bucket
+            kept = sorted({int(b) for b in self.decode_buckets
+                           if 0 < int(b) <= self.max_batch})
+            if not kept or kept[-1] != self.max_batch:
+                kept.append(self.max_batch)
+            self.decode_buckets = tuple(kept)
+        return self
+
+
+class _Request:
+    __slots__ = (
+        "id", "prompt", "max_new_tokens", "eos_token_id", "temperature",
+        "tokens", "error", "done", "stream_q", "cancelled",
+        "t_submit", "t_done",
+    )
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id, temperature,
+                 stream):
+        self.id = rid
+        self.prompt = prompt  # list[int]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self.tokens: Optional[List[int]] = None  # final ids, set at finish
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+        self.stream_q = _queue.Queue() if stream else None
+        self.cancelled = False
+        self.t_submit = time.monotonic()
+        self.t_done: Optional[float] = None
+
+
+class _Seq:
+    """Scheduler-side state of one admitted sequence. ``tokens`` holds
+    prompt + generated ids; the newest id's KV is NOT yet in cache — its
+    write position is ``pos = len(tokens) - 1``, which is also the next
+    decode step's fed token."""
+
+    __slots__ = ("req", "tokens", "blocks", "prompt_len")
+
+    def __init__(self, req: _Request, tokens: List[int]):
+        self.req = req
+        self.tokens = tokens
+        self.blocks: List[int] = []
+        self.prompt_len = len(req.prompt)
+
+    @property
+    def pos(self) -> int:
+        return len(self.tokens) - 1
+
+    @property
+    def generated(self) -> int:
+        return len(self.tokens) - self.prompt_len
+
+
+class RequestHandle:
+    """Client-side handle: blocking ``result()``, streaming iteration, and
+    ``cancel()``."""
+
+    def __init__(self, req: _Request, engine: "Engine"):
+        self._req = req
+        self._engine = engine
+
+    @property
+    def request_id(self) -> int:
+        return self._req.id
+
+    @property
+    def done(self) -> bool:
+        return self._req.done.is_set()
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        t = self._req.t_done
+        return None if t is None else t - self._req.t_submit
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Full token ids (prompt + generated), like ``generate()``. Raises
+        the request's failure (``RequestCancelled`` after ``cancel()``)."""
+        if not self._req.done.wait(timeout):
+            raise TimeoutError(f"request {self._req.id} still in flight")
+        if self._req.error is not None:
+            raise self._req.error
+        return list(self._req.tokens)
+
+    def cancel(self) -> None:
+        self._engine._cancel(self._req)
+
+    def __iter__(self):
+        """Generated token ids as they land (``submit(stream=True)``). Ends
+        cleanly on completion OR cancellation; terminal errors re-raise.
+        One-shot: tokens are consumed destructively, and iterating a handle
+        whose stream was already drained terminates instead of blocking."""
+        if self._req.stream_q is None:
+            raise ServeError("submit(stream=True) to iterate tokens")
+
+        def finish():
+            if self._req.error is not None and not isinstance(
+                    self._req.error, RequestCancelled):
+                raise self._req.error
+
+        while True:
+            try:
+                # the timeout only matters on an already-drained stream
+                # (sentinel consumed by a prior iteration); live streams
+                # return as soon as a token lands
+                item = self._req.stream_q.get(timeout=0.1)
+            except _queue.Empty:
+                if self._req.done.is_set() and self._req.stream_q.empty():
+                    finish()
+                    return
+                continue
+            if item is None:
+                finish()
+                return
+            yield item
+
+
+class Engine:
+    """Continuous-batching serving engine over a paged KV cache.
+
+    ``model`` is a ``GPTForPretraining`` or ``LlamaForCausalLM`` instance
+    with full logical weights. The engine thread owns all scheduler state;
+    only the submission queue and stop flag cross threads (guarded below).
+    """
+
+    def __init__(self, model, config: Optional[EngineConfig] = None, **overrides):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import generation as G
+
+        self._jax, self._jnp, self._G = jax, jnp, G
+        if hasattr(model, "gpt"):
+            arch_key, arch, params, max_pos = G.gpt_decode_state(model)
+        elif hasattr(model, "lm_head") and hasattr(model, "model"):
+            arch_key, arch, params, max_pos = G.llama_decode_state(model)
+        else:
+            raise TypeError(
+                f"serving.Engine: unsupported model {type(model).__name__} "
+                "(expected GPTForPretraining or LlamaForCausalLM)"
+            )
+        if config is not None and overrides:
+            raise ValueError("pass EngineConfig OR keyword overrides, not both")
+        # resolve a COPY: the caller's EngineConfig stays pristine (this
+        # engine's model clamps max_seq_len, so a reused config must not
+        # carry one model's clamp into the next engine)
+        cfg = copy.copy(config or EngineConfig(**overrides)).resolve(max_pos)
+        self.config = cfg
+        self._arch = arch
+        self._dtype = params["wte"].dtype
+        self._compute_params = params
+        if cfg.int8:
+            from .int8 import dequantize_tree, quantize_params
+
+            self._compute_params = quantize_params(params)
+            self._dequant = lambda p, _d=self._dtype: dequantize_tree(p, _d)
+        else:
+            self._dequant = None
+        self._n_layers = len(params["layers"])
+        kv, hd = arch["kv_heads"], arch["head_dim"]
+        self._max_blocks = -(-cfg.max_seq_len // cfg.block_size)
+        shape = (self._n_layers, cfg.num_blocks, cfg.block_size, kv, hd)
+        self._kpool = jnp.zeros(shape, self._dtype)
+        self._vpool = jnp.zeros(shape, self._dtype)
+        self._pool = PagePool(cfg.num_blocks)
+        self._prefill_buckets = self._make_prefill_buckets()
+
+        # engine-thread-only scheduler state
+        self._fns: Dict[tuple, object] = {}
+        self._running: List[_Seq] = []
+        self._resume: List[_Seq] = []  # preempted, awaiting re-prefill
+        self._admitting: List[_Seq] = []  # popped off the queue, mid-prefill
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._step_i = 0
+        self._occ_live = 0
+        self._occ_slots = 0
+
+        # cross-thread state
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._waiting: "collections.deque[_Request]" = collections.deque()  # guarded_by: _cv
+        self._stop = False  # guarded_by: _cv
+        self._broken: Optional[BaseException] = None
+        self._ids = itertools.count(1)
+
+        # Both the flight registry and the scheduler thread hold only a
+        # weakref: an abandoned (never-closed) engine stays collectable —
+        # __del__ then runs close(), the thread exits at its next deref,
+        # and the provider reports itself gone (the DevicePrefetcher
+        # teardown discipline from PR 6).
+        self._provider = f"serving_{next(_engine_ids)}"
+        wr = weakref.ref(self)
+        flight.add_context_provider(
+            self._provider,
+            lambda _wr=wr: (
+                e._flight_context() if (e := _wr()) is not None
+                else {"closed": True}
+            ),
+        )
+        self._thread = threading.Thread(
+            target=_engine_loop, args=(wr,), daemon=True, name=self._provider)
+        self._thread.start()
+
+    # ------------------------------------------------------------------ API
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None, temperature: float = 0.0,
+               stream: bool = False) -> RequestHandle:
+        """Enqueue one request (any thread). ``temperature == 0`` is greedy.
+        ``stream=True`` additionally feeds the handle's iterator per token."""
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("serving: empty prompt")
+        if int(max_new_tokens) < 1:
+            # prefill always yields the first generated token, so a 0-token
+            # budget cannot honor the prompt+max_new output contract
+            raise ValueError("serving: max_new_tokens must be >= 1")
+        total = len(prompt) + int(max_new_tokens)
+        if total > self.config.max_seq_len:
+            raise ValueError(
+                f"serving: prompt + max_new_tokens = {total} exceeds "
+                f"max_seq_len {self.config.max_seq_len}"
+            )
+        if -(-total // self.config.block_size) > self._pool.num_blocks - 1:
+            raise ValueError(
+                "serving: request needs more KV blocks than the whole pool; "
+                "raise FLAGS_serve_num_blocks"
+            )
+        with self._cv:
+            if self._stop or self._broken is not None:
+                raise ServeError("serving engine is closed") from self._broken
+            req = _Request(next(self._ids), prompt, max_new_tokens,
+                           eos_token_id, temperature, stream)
+            self._waiting.append(req)
+            counter_inc("serve_requests")
+            self._cv.notify()
+        return RequestHandle(req, self)
+
+    def generate(self, prompt_ids, **kw) -> List[int]:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(prompt_ids, **kw).result()
+
+    def stats(self) -> dict:
+        """Scheduler gauges (safe from any thread; running-set reads are
+        racy snapshots by design)."""
+        with self._lock:
+            depth = len(self._waiting)
+        occ = self._occ_live / self._occ_slots if self._occ_slots else 0.0
+        return {
+            "queue_depth": depth,
+            "running": len(self._running),
+            "preempted_waiting": len(self._resume),
+            "batch_occupancy_mean": round(occ, 4),
+            "pages_total": self._pool.num_blocks - 1,
+            "pages_used": self._pool.used_blocks,
+            "pages_free": self._pool.free_blocks,
+            "compiles": len(self._fns),
+            "decode_steps": self._step_i,
+        }
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop the engine thread; outstanding requests fail with
+        ``ServeError``. Idempotent."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        # provider first: it must go even when the join below is skipped
+        # (close() can run ON the scheduler thread — __del__ fires there
+        # when the loop's deref holds the last reference)
+        flight.remove_context_provider(self._provider)
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close(timeout=2.0)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- engine thread
+    def _run_once(self) -> bool:
+        """One scheduler iteration (bounded idle wait). True = stopped."""
+        with self._cv:
+            if not self._stop and not self._waiting and not self._running \
+                    and not self._resume:
+                self._cv.wait(timeout=0.5)
+            if self._stop:
+                return True
+            has_work = bool(self._waiting or self._running or self._resume)
+        if has_work:
+            self._step()
+        return False
+
+    def _step(self):
+        with span("schedule", step=self._step_i,
+                  running=len(self._running)) as sp:
+            self._drain_cancels()
+            # track mid-prefill sequences so a loop crash fails their
+            # handles instead of orphaning them (they are in neither
+            # _waiting nor _running until prefill lands); cleared only on
+            # success — _shutdown sweeps it after a crash
+            self._admitting = self._admit()
+            if self._admitting:
+                self._prefill(self._admitting)
+            self._admitting = []
+            if self._running:
+                self._decode()
+            sp.set(running_after=len(self._running))
+
+    # -- admission ----------------------------------------------------------
+    def _make_prefill_buckets(self) -> Sequence[int]:
+        bs, t_pad = self.config.block_size, self._max_blocks * self.config.block_size
+        buckets, b = [], bs
+        while b < t_pad:
+            buckets.append(b)
+            b *= 2
+        buckets.append(t_pad)
+        return tuple(buckets)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._prefill_buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"no prefill bucket covers length {n}")
+
+    def _headroom_ok(self, need: int, extra_running: int) -> bool:
+        # AFTER granting `need`, keep one spare block per running sequence so
+        # the next decode steps don't immediately preempt what admission
+        # just packed in (a prefill paid, then discarded, is pure waste)
+        return self._pool.free_blocks - need >= len(self._running) + extra_running
+
+    def _admit(self) -> List[_Seq]:
+        admitted: List[_Seq] = []
+        with span("admit") as sp:
+            # preempted sequences first: they already hold tokens and their
+            # latency clock is running
+            still_resume = []
+            for seq in self._resume:
+                need = -(-len(seq.tokens) // self.config.block_size)
+                if len(self._running) + len(admitted) >= self.config.max_batch:
+                    still_resume.append(seq)
+                    continue
+                blocks = (self._pool.alloc(need)
+                          if self._headroom_ok(need, len(admitted) + 1) else None)
+                if blocks is None:
+                    still_resume.append(seq)
+                    continue
+                seq.blocks = blocks
+                admitted.append(seq)
+            self._resume = still_resume
+            while len(self._running) + len(admitted) < self.config.max_batch:
+                with self._cv:
+                    req = self._waiting[0] if self._waiting else None
+                    if req is None:
+                        break
+                    if req.cancelled:
+                        self._waiting.popleft()
+                        self._finish_request(req, error=RequestCancelled(
+                            f"request {req.id} cancelled"))
+                        continue
+                    need = -(-len(req.prompt) // self.config.block_size)
+                    blocks = (self._pool.alloc(need)
+                              if self._headroom_ok(need, len(admitted) + 1) else None)
+                    if blocks is None:
+                        counter_inc("serve_backpressure")
+                        break
+                    self._waiting.popleft()
+                seq = _Seq(req, list(req.prompt))
+                seq.blocks = blocks
+                admitted.append(seq)
+            if admitted:
+                counter_inc("serve_admitted", len(admitted))
+            sp.set(admitted=len(admitted), resume_waiting=len(self._resume))
+        return admitted
+
+    # -- prefill -------------------------------------------------------------
+    def _prefill(self, seqs: List[_Seq]):
+        jnp = self._jnp
+        groups: Dict[int, List[_Seq]] = {}
+        for s in seqs:
+            groups.setdefault(self._bucket_for(len(s.tokens)), []).append(s)
+        for t_bucket in sorted(groups):
+            group = groups[t_bucket]
+            bw = self.config.prefill_batch
+            for i in range(0, len(group), bw):
+                chunk = group[i:i + bw]
+                with span("prefill", bucket_t=t_bucket, bucket_b=bw,
+                          rows=len(chunk)):
+                    fn = self._get_fn("prefill", bw, t_bucket)
+                    ids = np.zeros((bw, t_bucket), np.int32)
+                    lens = np.ones((bw,), np.int32)
+                    tables = np.full((bw, self._max_blocks), TRASH_BLOCK,
+                                     np.int32)
+                    for r, s in enumerate(chunk):
+                        ids[r, :len(s.tokens)] = s.tokens
+                        lens[r] = len(s.tokens)
+                        tables[r, :len(s.blocks)] = s.blocks
+                    self._kpool, self._vpool, logits = fn(
+                        self._compute_params, jnp.asarray(ids),
+                        jnp.asarray(lens), jnp.asarray(tables),
+                        self._kpool, self._vpool,
+                    )
+                    counter_inc("serve_prefills")
+                    rows = np.asarray(logits)
+                    for r, s in enumerate(chunk):
+                        self._append_token(s, self._sample_host(rows[r], s.req))
+                        if not s.req.done.is_set():
+                            self._running.append(s)
+
+    def _sample_host(self, logits_row: np.ndarray, req: _Request) -> int:
+        """First generated token (prefill output) is sampled host-side; the
+        greedy argmax matches the in-graph decode argmax bit-for-bit."""
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits_row))
+        z = logits_row.astype(np.float64) / max(req.temperature, 1e-6)
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    # -- decode --------------------------------------------------------------
+    def _grow_blocks(self):
+        """Every live sequence needs block ``pos // block_size`` mapped
+        before the step; pool exhaustion preempts the youngest peer (evict →
+        requeue for re-prefill) — backpressure, never failure."""
+        for seq in list(self._running):
+            if seq not in self._running:
+                continue  # evicted by an earlier iteration
+            need = seq.pos // self.config.block_size + 1 - len(seq.blocks)
+            while need > 0:
+                with span("page_alloc", request=seq.req.id, blocks=need):
+                    got = self._pool.alloc(need)
+                if got is not None:
+                    seq.blocks.extend(got)
+                    break
+                victims = [s for s in self._running if s is not seq]
+                if not victims:
+                    # a lone sequence always fits (submit() bounds it), so
+                    # this is unreachable unless accounting broke
+                    raise ServeError(
+                        f"page pool exhausted by a single sequence "
+                        f"(request {seq.req.id})"
+                    )
+                self._evict(victims[-1])
+
+    def _evict(self, seq: _Seq):
+        with span("evict", request=seq.req.id, generated=seq.generated):
+            self._pool.free(seq.blocks)
+            seq.blocks = []
+            self._running.remove(seq)
+            self._resume.append(seq)
+            counter_inc("serve_preempted")
+
+    def _decode(self):
+        jnp, jax = self._jnp, self._jax
+        self._grow_blocks()
+        if not self._running:
+            return
+        n = len(self._running)
+        bb = next(b for b in self.config.decode_buckets if b >= n)
+        tables = np.full((bb, self._max_blocks), TRASH_BLOCK, np.int32)
+        pos = np.zeros((bb,), np.int32)
+        toks = np.zeros((bb,), np.int32)
+        temps = np.zeros((bb,), np.float32)
+        for r, s in enumerate(self._running):
+            tables[r, :len(s.blocks)] = s.blocks
+            pos[r] = s.pos
+            toks[r] = s.tokens[-1]
+            temps[r] = s.req.temperature
+        self._key, sub = jax.random.split(self._key)
+        with span("decode_step", bucket=bb, rows=n, step=self._step_i):
+            fn = self._get_fn("decode", bb)
+            self._kpool, self._vpool, nxt = fn(
+                self._compute_params, self._kpool, self._vpool,
+                jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(toks),
+                jnp.asarray(temps), sub,
+            )
+        nxt = np.asarray(nxt)
+        self._step_i += 1
+        self._occ_live += n
+        self._occ_slots += bb
+        counter_inc("serve_decode_steps")
+        counter_inc("serve_occupancy_live", n)
+        counter_inc("serve_occupancy_slots", bb)
+        for r, s in enumerate(list(self._running)):
+            self._append_token(s, int(nxt[r]))
+
+    def _append_token(self, seq: _Seq, tok: int):
+        """Record one generated token; retire the sequence when it hits eos,
+        its budget, or a cancel flag."""
+        req = seq.req
+        seq.tokens.append(tok)
+        counter_inc("serve_tokens")
+        if req.stream_q is not None:
+            req.stream_q.put(tok)
+        if req.cancelled:
+            self._retire(seq, error=RequestCancelled(
+                f"request {req.id} cancelled"))
+        elif (req.eos_token_id is not None and tok == req.eos_token_id) \
+                or seq.generated >= req.max_new_tokens:
+            self._retire(seq)
+
+    def _retire(self, seq: _Seq, error: Optional[BaseException] = None):
+        self._pool.free(seq.blocks)
+        seq.blocks = []
+        if seq in self._running:
+            self._running.remove(seq)
+        self._finish_request(seq.req, tokens=seq.tokens, error=error)
+
+    def _finish_request(self, req: _Request, tokens=None, error=None):
+        if req.done.is_set():
+            return  # the crash sweep may see a sequence twice
+        req.tokens = list(tokens) if tokens is not None else None
+        req.error = error
+        req.t_done = time.monotonic()
+        counter_inc("serve_cancelled" if isinstance(error, RequestCancelled)
+                    else "serve_failed" if error is not None
+                    else "serve_retired")
+        if req.stream_q is not None:
+            req.stream_q.put(None)
+        req.done.set()
+
+    # -- cancellation / teardown ---------------------------------------------
+    def _cancel(self, req: _Request):
+        with self._cv:
+            req.cancelled = True
+            self._cv.notify()
+
+    def _drain_cancels(self):
+        for seq in [s for s in self._running if s.req.cancelled]:
+            self._retire(seq, error=RequestCancelled(
+                f"request {seq.req.id} cancelled"))
+        for seq in [s for s in self._resume if s.req.cancelled]:
+            self._resume.remove(seq)
+            self._finish_request(seq.req, error=RequestCancelled(
+                f"request {seq.req.id} cancelled"))
+        # queued-but-unadmitted cancels must not wait for a batch slot: a
+        # saturated engine would otherwise sit on them for minutes
+        with self._cv:
+            cancelled = [r for r in self._waiting if r.cancelled]
+            for req in cancelled:
+                self._waiting.remove(req)
+        for req in cancelled:
+            self._finish_request(req, error=RequestCancelled(
+                f"request {req.id} cancelled"))
+
+    def _shutdown(self):
+        err = self._broken or ServeError("serving engine closed")
+        with self._cv:
+            waiting = list(self._waiting)
+            self._waiting.clear()
+        for req in waiting:
+            self._finish_request(req, error=ServeError(str(err)))
+        # _admitting covers sequences a crash caught mid-prefill; the
+        # done-guard in _finish_request dedupes any that made it to _running.
+        # Per-sequence guards: when the crash WAS a pool inconsistency, the
+        # same free() would raise again here — one bad sequence must not
+        # stop us failing the remaining handles.
+        for seq in list(self._running) + list(self._resume) + list(self._admitting):
+            try:
+                if seq.blocks:
+                    self._pool.free(seq.blocks)
+            except Exception:
+                pass
+            seq.blocks = []
+            try:
+                self._finish_request(seq.req, error=ServeError(str(err)))
+            except Exception:
+                pass
+        self._running, self._resume, self._admitting = [], [], []
+
+    # -- compiled-program cache ----------------------------------------------
+    def _get_fn(self, kind: str, *bucket):
+        """One jitted program per (kind, bucket shape); the count of entries
+        IS the compile count the bucket policy promises (<= buckets used)."""
+        key = (kind,) + bucket
+        fn = self._fns.get(key)
+        if fn is None:
+            jax, G = self._jax, self._G
+            if kind == "prefill":
+                bw, t_bucket = bucket
+                raw = G.build_paged_prefill(
+                    self._arch, bw, t_bucket, self.config.block_size,
+                    self._max_blocks)
+                donate = (4, 5)
+            else:
+                (bb,) = bucket
+                raw = G.build_paged_decode(
+                    self._arch, bb, self.config.block_size, self._max_blocks)
+                donate = (1, 2)
+            if self._dequant is not None:
+                dq, inner = self._dequant, raw
+
+                def raw(params, *args, _dq=dq, _inner=inner):
+                    return _inner(_dq(params), *args)
+
+            # donation lets XLA update the pools in place; CPU ignores the
+            # hint (it would only warn), so only pass it off-CPU
+            if jax.default_backend() == "cpu":
+                fn = jax.jit(raw)
+            else:
+                fn = jax.jit(raw, donate_argnums=donate)
+            self._fns[key] = fn
+            counter_inc("serve_compiles")
+        return fn
+
+    # -- flight-recorder context ----------------------------------------------
+    def _flight_context(self) -> dict:
+        with self._lock:
+            depth = len(self._waiting)
+        return {
+            "queue_depth": depth,
+            "step": self._step_i,
+            "pages": {"used": self._pool.used_blocks,
+                      "free": self._pool.free_blocks},
+            "running": [
+                {"id": s.req.id, "prompt_len": s.prompt_len,
+                 "generated": s.generated, "pos": s.pos,
+                 "blocks": len(s.blocks)}
+                for s in list(self._running)
+            ],
+        }
+
+    # -- test/debug hook -------------------------------------------------------
+    def _debug_prefill_logits(self, prompt_ids) -> np.ndarray:
+        """Logits at the prompt's last token through the REAL bucketed
+        prefill program, with every table entry pointed at the trash block
+        (no allocation, pool contents untouched where it matters). Callers
+        must hold the engine idle — this runs on the calling thread."""
+        jnp = self._jnp
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        t_bucket = self._bucket_for(len(prompt))
+        bw = self.config.prefill_batch
+        fn = self._get_fn("prefill", bw, t_bucket)
+        ids = np.zeros((bw, t_bucket), np.int32)
+        ids[0, :len(prompt)] = prompt
+        lens = np.ones((bw,), np.int32)
+        lens[0] = len(prompt)
+        tables = np.full((bw, self._max_blocks), TRASH_BLOCK, np.int32)
+        self._kpool, self._vpool, logits = fn(
+            self._compute_params, jnp.asarray(ids), jnp.asarray(lens),
+            jnp.asarray(tables), self._kpool, self._vpool,
+        )
+        return np.asarray(logits[0])
+
+
+def _engine_loop(wr):
+    """Scheduler thread body. Holds the engine only through a weakref and
+    re-derefs every iteration, so an abandoned engine is GC-collectable
+    (its __del__ runs close(); a dead deref also just ends the thread)."""
+    while True:
+        eng = wr()
+        if eng is None:
+            return
+        try:
+            stopped = eng._run_once()
+        except Exception as e:
+            # fail loudly into every pending handle rather than leave
+            # clients blocked on events that will never fire — and nothing
+            # (not even a failing post-mortem) may stand between the crash
+            # and that sweep
+            eng._broken = e
+            try:
+                counter_inc("serve_engine_errors")
+                flight.dump("serving_loop_error", extra={"exception": repr(e)})
+            finally:
+                eng._shutdown()
+            return
+        if stopped:
+            eng._shutdown()
+            return
+        del eng

@@ -1,0 +1,59 @@
+"""int8-quantized-weights serving path.
+
+Weight-only int8 over the decode weight tree via the existing PTQ machinery
+(``quantization.quantize_to_int8``: symmetric per-tensor abs-max, the same
+rounding the PTQ pass folds into checkpoints): every float matrix (>= 2-D —
+projections, embeddings, the tied/untied head) is stored in HBM as an int8
+array plus one f32 scale, ~4x smaller than f32, and dequantized inside the
+compiled prefill/decode programs right before use (``q * scale / 127``).
+1-D params (biases, norm gains) stay float — they are noise-critical and
+tiny.
+
+The tagged-dict encoding keeps the tree a plain pytree, so the same bucket
+programs jit over either representation; ``dequantize_tree`` is traced into
+the program, where XLA schedules the dequant next to the consuming matmul.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["quantize_params", "dequantize_tree"]
+
+_TAG = "__int8__"
+
+
+def quantize_params(tree):
+    """Quantize every float array of rank >= 2 in a nested dict/list/tuple
+    weight tree to ``{_TAG: int8, "scale": f32[]}``."""
+    from ..quantization import quantize_to_int8
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        if hasattr(node, "ndim") and node.ndim >= 2 and \
+                jnp.issubdtype(node.dtype, jnp.floating):
+            q, scale = quantize_to_int8(node)
+            return {_TAG: q._data, "scale": jnp.asarray(scale, jnp.float32)}
+        return node
+
+    return walk(tree)
+
+
+def dequantize_tree(tree, dtype):
+    """Inverse of :func:`quantize_params`, traced inside the compiled
+    programs: tagged leaves become dense ``dtype`` arrays again. ``dtype``
+    is static (closed over by the program), never part of the pytree."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            if _TAG in node:
+                return (node[_TAG].astype(jnp.float32)
+                        * (node["scale"] / 127.0)).astype(dtype)
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        return node
+
+    return walk(tree)

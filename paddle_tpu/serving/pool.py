@@ -1,0 +1,81 @@
+"""Fixed-size KV block pool — the paged-cache allocator.
+
+HBM holds ONE preallocated pool of ``num_blocks`` KV blocks per engine
+(``(layers, num_blocks, block_size, kv_heads, head_dim)`` for K and V);
+sequences own ``ceil(len / block_size)`` block ids each, recorded in a
+per-sequence block table, so resident cache memory is ``Σ ceil(len/block)``
+blocks instead of ``batch × T_max`` dense caches.
+
+Block 0 is the reserved TRASH block: padding rows of a bucketed batch and
+padded tail entries of short rows point their table slots at it, so the
+compiled programs can scatter unconditionally — trash is written freely and
+never read (the live mask excludes every position it could back).
+
+The allocator is free-list + owned-set bookkeeping with hard invariants:
+allocating more than is free returns ``None`` (the scheduler turns that into
+queue backpressure or preemption, never a crash), freeing an unowned id
+raises (double-free), and ``check()`` asserts conservation. Engine-thread
+only — the scheduler is the single owner, so no lock is needed here.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..profiler import counter_inc
+
+__all__ = ["PagePool", "TRASH_BLOCK"]
+
+TRASH_BLOCK = 0
+
+
+class PagePool:
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("PagePool needs >= 2 blocks (block 0 is trash)")
+        self.num_blocks = int(num_blocks)
+        # LIFO free list: recently-freed blocks are re-used first (warm)
+        self._free: List[int] = list(range(self.num_blocks - 1, TRASH_BLOCK, -1))
+        self._owned = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._owned)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` block ids, or None when the pool can't cover them (the
+        caller's backpressure signal — nothing is partially allocated)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._owned.update(ids)
+        counter_inc("serve_pages_allocated", n)
+        return ids
+
+    def free(self, ids) -> None:
+        for b in ids:
+            if b not in self._owned:
+                raise RuntimeError(
+                    f"PagePool: double-free or foreign block id {b}"
+                )
+            self._owned.remove(b)
+            self._free.append(b)
+        counter_inc("serve_pages_freed", len(ids))
+
+    def check(self) -> None:
+        """Conservation invariant: every non-trash block is exactly one of
+        free or owned."""
+        if len(self._free) + len(self._owned) != self.num_blocks - 1:
+            raise RuntimeError(
+                f"PagePool leak: {len(self._free)} free + "
+                f"{len(self._owned)} owned != {self.num_blocks - 1}"
+            )
+        if self._owned & set(self._free):
+            raise RuntimeError("PagePool: block both free and owned")
+        if TRASH_BLOCK in self._owned or TRASH_BLOCK in self._free:
+            raise RuntimeError("PagePool: trash block entered circulation")

@@ -88,6 +88,32 @@ def test_predictor_clone_threads(saved_model):
     assert len(errs) == 4 and max(errs) < 1e-5
 
 
+def test_shared_predictor_concurrent_list_api(saved_model):
+    """ONE predictor instance (no clones) hammered from two threads via the
+    list API: run() stages + executes + returns under a single _lock hold
+    (# guarded_by: covered by the lock-discipline checker), so concurrent
+    callers serialize instead of tearing each other's slots."""
+    prefix, lin = saved_model
+    pred = create_predictor(Config(prefix))
+    errs = []
+    xs = [np.random.RandomState(s).randn(2, 16).astype(np.float32)
+          for s in range(2)]
+
+    def work(x):
+        try:
+            for _ in range(20):
+                out = pred.run([x])[0]
+                want = np.asarray(lin(paddle.to_tensor(x))._data)
+                assert np.abs(out - want).max() < 1e-5
+        except Exception as e:  # surfaced to the main thread below
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(x,)) for x in xs]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs, errs
+
+
 def test_error_paths(saved_model):
     prefix, _ = saved_model
     with pytest.raises(ValueError, match="not found"):
