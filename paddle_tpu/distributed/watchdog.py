@@ -17,6 +17,11 @@ module converts that into bounded-time, attributed recovery:
 Disabled-path contract (tier-1 tripwire): with ``FLAGS_collective_timeout_s=0``
 the watchdog adds **zero host syncs and zero threads** — ``guard`` is a flag
 probe, ``publish`` without a configured session is a no-op attribute check.
+
+Serving (round 12): a supervised serving engine publishes ``serve.step``
+phase records through ``publish(unit=...)`` — per-unit sub-records in this
+rank's progress entry, so the cross-rank table carries serving progress
+without clobbering the training step (serving/supervisor.py).
 """
 from __future__ import annotations
 
@@ -30,8 +35,8 @@ from ..framework import flags as _flags
 
 __all__ = [
     "configure", "reset", "configured", "enabled", "timeout_s", "publish",
-    "local_progress", "progress_table", "suspect", "guard", "guarded_wait",
-    "trip", "set_abort_fn",
+    "remove_unit", "local_progress", "progress_table", "suspect", "guard",
+    "guarded_wait", "trip", "set_abort_fn",
 ]
 
 _flags.register_flag("FLAGS_collective_timeout_s", 0.0)
@@ -54,6 +59,17 @@ _monitor_wake = threading.Event()
 _monitor_stop = threading.Event()
 
 _PROGRESS_PREFIX = "wd/progress"
+
+
+def _snapshot_local_locked() -> dict:
+    """Copy of ``_local`` safe to serialize OUTSIDE ``_lock`` (caller must
+    hold it): the ``units`` sub-dict is deep-copied, since another thread's
+    unit insert during a later ``json.dumps`` on a shallow alias is a
+    RuntimeError mid-train-step."""
+    rec = dict(_local)
+    if "units" in rec:
+        rec["units"] = {k: dict(v) for k, v in rec["units"].items()}
+    return rec
 
 
 def _default_abort(code: int) -> None:
@@ -179,17 +195,29 @@ def enabled() -> bool:
 
 # -- progress ----------------------------------------------------------------
 def publish(step: Optional[int] = None, phase: Optional[str] = None,
-            span: Optional[str] = None, force: bool = False) -> None:
+            span: Optional[str] = None, force: bool = False,
+            unit: Optional[str] = None) -> None:
     """Record this rank's progress. Called at step boundaries (engine /
     training loops) and phase transitions (checkpoint, drain). Near-zero
     when no session is configured; the store/file write-through is
     rate-limited to one per ``_PUSH_INTERVAL_S``. Chaos injection points
-    ``rank.kill`` / ``rank.hang`` / ``rank.slow`` fire here."""
+    ``rank.kill`` / ``rank.hang`` / ``rank.slow`` fire here.
+
+    ``unit`` scopes the record to a named sub-unit of this rank — e.g. a
+    supervised serving engine's scheduler thread publishing ``serve.step``
+    phase records — landing under ``units[unit]`` in the rank's record
+    instead of clobbering the training step/phase, so the progress table
+    (and every flight dump carrying it) shows serving progress next to
+    training progress."""
     from ..fault import inject as _inject
 
     cfg = _cfg
     rank = cfg["rank"] if cfg else None
-    if _inject._armed:
+    if _inject._armed and unit is None:
+        # rank-level chaos (rank.kill/hang/slow) fires only on RANK-level
+        # publishes: a serving engine's unit publish must not evaluate a
+        # training-targeted `rank.hang:at=N` against the serving step
+        # counter (the serving path has its own serve.* points)
         _inject.chaos(step=step, rank=rank, phase=phase)
     if cfg is None:
         return
@@ -197,19 +225,41 @@ def publish(step: Optional[int] = None, phase: Optional[str] = None,
     now = time.time()       # record timestamp: peers compare it cross-process
     mono = time.monotonic()  # rate-limit clock: immune to wall-clock jumps
     with _lock:
-        if step is not None:
-            _local["step"] = int(step)
-        if phase is not None:
-            _local["phase"] = str(phase)
-        if span is not None:
-            _local["span"] = str(span)
-        _local["ts"] = now
-        rec = dict(_local)
+        if unit is not None:
+            units = _local.setdefault("units", {})
+            rec_u = dict(units.get(unit) or {})
+            if step is not None:
+                rec_u["step"] = int(step)
+            if phase is not None:
+                rec_u["phase"] = str(phase)
+            if span is not None:
+                rec_u["span"] = str(span)
+            rec_u["ts"] = now
+            units[unit] = rec_u
+        else:
+            if step is not None:
+                _local["step"] = int(step)
+            if phase is not None:
+                _local["phase"] = str(phase)
+            if span is not None:
+                _local["span"] = str(span)
+            # rank-level freshness moves ONLY on rank-level publishes: a
+            # live serving engine must not keep a hung training loop's
+            # timestamp fresh (suspect() ranks stalest-ts among step ties);
+            # unit records carry their own ts above
+            _local["ts"] = now
+        rec = _snapshot_local_locked()
         due = force or (mono - _last_push) >= _PUSH_INTERVAL_S
         if due:
             _last_push = mono
     if not due:
         return
+    _push_record(rec, cfg)
+
+
+def _push_record(rec: dict, cfg: dict) -> None:
+    """Write one progress record through to the store and/or progress file
+    (shared by publish and remove_unit)."""
     payload = json.dumps(rec)
     store = cfg["store"]
     if store is not None:
@@ -228,10 +278,29 @@ def publish(step: Optional[int] = None, phase: Optional[str] = None,
             pass
 
 
+def remove_unit(unit: str) -> None:
+    """Drop a sub-unit's progress record. A closed or quarantined serving
+    engine must not leave a stale ``units`` entry riding every heartbeat
+    merge, progress-file write, and flight dump forever — each supervisor
+    restart would otherwise accumulate one dead unit per engine
+    incarnation. The removal WRITES THROUGH immediately: waiting for the
+    next publish would leave the dead unit persisted indefinitely in a
+    process where the closed engine was the last publisher."""
+    cfg = _cfg
+    if cfg is None:
+        return
+    with _lock:
+        units = _local.get("units")
+        if not units or units.pop(unit, None) is None:
+            return
+        rec = _snapshot_local_locked()
+    _push_record(rec, cfg)
+
+
 def local_progress() -> dict:
     """This rank's latest record (merged into the elastic heartbeat value)."""
     with _lock:
-        return dict(_local)
+        return _snapshot_local_locked()
 
 
 def _read_progress_dir(pdir: str) -> Dict[int, dict]:

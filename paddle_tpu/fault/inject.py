@@ -36,7 +36,12 @@ named op's output with NaN (threaded through eager and lazy dispatch).
 Chaos points (``rank.kill`` / ``rank.hang`` / ``rank.slow`` /
 ``collective.drop``) execute their action in-process via :func:`chaos` /
 :func:`chaos_drop`, threaded through the distributed watchdog's progress
-publications and guarded collectives.
+publications and guarded collectives. Serving chaos points (``serve.crash``
+/ ``serve.wedge`` / ``serve.slow_step`` / ``serve.pool_corrupt``) are
+consulted by the serving engine's scheduler thread at every step boundary —
+they drive the ServingSupervisor recovery suite (tests/test_serving_chaos.py).
+``serve.wedge`` wedges the scheduler thread forever by default (the
+supervisor abandons it); ``ms=N`` bounds the wedge for detection-only tests.
 """
 from __future__ import annotations
 
@@ -60,6 +65,11 @@ POINTS: Dict[str, str] = {
     "ckpt.serialize": "coordinated save — crash during state serialization",
     "ckpt.ack": "coordinated save — crash after durable write, before the ack",
     "ckpt.commit": "coordinated save — crash between full acks and the commit record",
+    # -- serving chaos points (serving/engine.py scheduler step boundary) -----
+    "serve.crash": "serving engine loop — raise inside the scheduler step",
+    "serve.wedge": "serving engine loop — wedge the scheduler thread (ms=N bounds it)",
+    "serve.slow_step": "serving engine loop — per-step straggler delay (ms=N, default 100)",
+    "serve.pool_corrupt": "serving engine loop — break PagePool conservation (next free raises)",
 }
 
 

@@ -96,6 +96,22 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_serve_prefill_batch": 4,
     "FLAGS_serve_max_seq_len": 2048,
     "FLAGS_serve_int8": False,
+    # Serving resilience (serving/engine.py + serving/supervisor.py).
+    # FLAGS_serve_max_queue sets the queue depth at which the shed policy
+    # engages (0 = never); it is only enforced when FLAGS_serve_shed is ALSO
+    # set, in which case submit() past the cap fast-fails with a structured
+    # Overloaded (Retry-After-style retry_after_s hint) instead of letting
+    # queue latency grow without bound — with shed off, the queue stays
+    # unbounded (PR 11 semantics). FLAGS_serve_watchdog_s is the
+    # ServingSupervisor's liveness
+    # deadline: a crashed or wedged engine scheduler thread is detected
+    # within this many seconds (heartbeat staleness), in-flight work is
+    # failed or requeued, and the engine restarts over the same model/pool
+    # config. All three are EngineConfig/supervisor overridable per engine;
+    # none adds threads or host syncs when left at the defaults.
+    "FLAGS_serve_max_queue": 0,
+    "FLAGS_serve_shed": False,
+    "FLAGS_serve_watchdog_s": 10.0,
     # JAX persistent compilation cache (warm executable starts across
     # processes). Dir defaults to ~/.cache/paddle_tpu/xla when unset.
     "FLAGS_xla_persistent_cache": True,

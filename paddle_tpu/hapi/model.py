@@ -108,14 +108,25 @@ class Model:
         callbacks=None,
         accumulate_grad_batches=1,
         num_iters=None,
+        device_prefetch=0,
     ):
+        # device_prefetch=N stages the next N batches ON DEVICE while the
+        # current step runs (the PR 6 DevicePrefetcher double-buffering,
+        # plumbed through to the fit loop — ROADMAP item 2 leftover). 0 = off.
+        device_prefetch = int(device_prefetch or 0)
+        wrap_prefetch = False
         if not isinstance(train_data, DataLoader):
             train_loader = DataLoader(
                 train_data, batch_size=batch_size, shuffle=shuffle,
                 drop_last=drop_last, num_workers=num_workers,
+                device_prefetch=device_prefetch,
             )
         else:
             train_loader = train_data
+            # a loader built with its own device_prefetch already returns a
+            # prefetching iterator — don't double-buffer the double-buffer
+            wrap_prefetch = (device_prefetch > 0
+                             and not getattr(train_loader, "device_prefetch", 0))
         eval_loader = None
         if eval_data is not None:
             eval_loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(eval_data, batch_size=batch_size)
@@ -132,17 +143,29 @@ class Model:
                 m.reset()
             cbks.on_epoch_begin(epoch)
             logs = {}
-            for step, batch in enumerate(train_loader):
-                cbks.on_batch_begin("train", step, logs)
-                ins, labs = self._split_batch(batch)
-                result = self.train_batch(ins, labs)
-                logs = self._make_logs(result)
-                logs["step"] = step
-                logs["batch_size"] = batch_size
-                cbks.on_batch_end("train", step, logs)
-                steps_done += 1
-                if num_iters is not None and steps_done >= num_iters:
-                    break
+            epoch_iter = train_loader
+            if wrap_prefetch:
+                from ..io import device_prefetch as _device_prefetch
+
+                epoch_iter = _device_prefetch(
+                    train_loader, buffer_size=device_prefetch)
+            try:
+                for step, batch in enumerate(epoch_iter):
+                    cbks.on_batch_begin("train", step, logs)
+                    ins, labs = self._split_batch(batch)
+                    result = self.train_batch(ins, labs)
+                    logs = self._make_logs(result)
+                    logs["step"] = step
+                    logs["batch_size"] = batch_size
+                    cbks.on_batch_end("train", step, logs)
+                    steps_done += 1
+                    if num_iters is not None and steps_done >= num_iters:
+                        break
+            finally:
+                if epoch_iter is not train_loader:
+                    # an early break must not leave the prefetch thread
+                    # staging batches against an abandoned epoch
+                    epoch_iter.close()
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, verbose=0)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})

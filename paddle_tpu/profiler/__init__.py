@@ -124,6 +124,21 @@ def counters() -> Dict[str, int]:
     ``Engine.stats()`` and ride every flight-recorder dump via the
     engine's context provider.
 
+    Serving resilience (round 12): ``serve_shed`` (submissions fast-failed
+    ``Overloaded`` at the queue cap), ``serve_deadline_shed`` (queued
+    requests shed expired/doomed at admission) and
+    ``serve_deadline_expired`` (running/preempted requests expired at a
+    step boundary), ``serve_wedged_close`` (close() joins that timed out on
+    a wedged scheduler thread), ``serve_crash_detected`` /
+    ``serve_wedge_detected`` / ``serve_restarts`` / ``serve_requeued`` /
+    ``serve_relayed`` (ServingSupervisor recovery: failures detected,
+    engines restarted, requests resubmitted onto the fresh engine, and
+    originals completed through the recovery relay — a requeued request's
+    CONTINUATION counts once in serve_requests/serve_retired on the new
+    engine, while the original's relay completion counts only in
+    serve_relayed, so lifecycle counters stay per-logical-outcome), and
+    ``serve_pool_damaged`` (serve.pool_corrupt chaos firings).
+
     Telemetry: ``flight_dumps`` (flight-recorder post-mortems written by
     this process).
 

@@ -12,17 +12,30 @@ Front door::
             ...
         ids = h.result()       # or blocking; h.cancel() mid-stream
 
+Resilience layer (round 12): ``submit(deadline_s=, priority=)`` attaches
+per-request deadlines (shed with ``DeadlineExceeded`` when expired/doomed)
+and admission/eviction priorities; ``FLAGS_serve_max_queue`` +
+``FLAGS_serve_shed`` turn overload into fast-fail ``Overloaded`` with a
+``retry_after_s`` hint; ``ServingSupervisor`` detects a crashed/wedged
+engine loop within ``FLAGS_serve_watchdog_s`` and restarts it with greedy
+in-flight work requeued bit-identically; ``health()``/``ready()`` +
+``close(drain=True)`` support rolling restarts.
+
 See serving/engine.py for the scheduler, serving/pool.py for the paged KV
-block allocator, serving/int8.py for the weight-only int8 path, and the
-README "Serving" section for bucketing, backpressure and cancellation
+block allocator, serving/int8.py for the weight-only int8 path,
+serving/supervisor.py for crash/wedge recovery, and the README "Serving"
+section for bucketing, backpressure, deadline/shedding and supervision
 semantics.
 """
 from .engine import (  # noqa: F401
-    Engine, EngineConfig, RequestCancelled, RequestHandle, ServeError,
+    DeadlineExceeded, Engine, EngineConfig, Overloaded, RequestCancelled,
+    RequestHandle, ServeError,
 )
 from .pool import PagePool, TRASH_BLOCK  # noqa: F401
+from .supervisor import ServingSupervisor  # noqa: F401
 
 __all__ = [
     "Engine", "EngineConfig", "RequestHandle", "ServeError",
-    "RequestCancelled", "PagePool", "TRASH_BLOCK",
+    "RequestCancelled", "DeadlineExceeded", "Overloaded",
+    "ServingSupervisor", "PagePool", "TRASH_BLOCK",
 ]

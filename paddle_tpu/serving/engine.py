@@ -25,12 +25,33 @@ programs in ``models/generation.py``:
   pass batched by length bucket; decode is one packed batch with per-row
   positions and live masks;
 * **int8 serving** (``int8=True``) — weight-only int8 via the PTQ rounding
-  (serving/int8.py), dequantized inside the compiled programs.
+  (serving/int8.py), dequantized inside the compiled programs;
+* **deadlines, priorities, load shedding** (resilience layer) —
+  ``submit(deadline_s=, priority=)`` attaches a completion deadline and an
+  admission/eviction priority to a request. The scheduler sheds expired and
+  doomed requests at admission and at every step boundary (a queued request
+  that cannot meet its deadline even if admitted now — prefill + full token
+  budget at the measured decode-step EMA — fails early with a structured
+  :class:`DeadlineExceeded` instead of occupying the batch), eviction under
+  pool pressure is priority-then-youngest, and the overload policy
+  (``FLAGS_serve_max_queue`` + ``FLAGS_serve_shed``) turns unbounded queue
+  growth into fast-fail :class:`Overloaded` with a Retry-After-style
+  ``retry_after_s`` hint. None of it costs anything unconfigured: the sweep
+  is gated on a has-deadlines bool, priority selection on a has-priorities
+  bool, the shed check is two attribute probes — zero threads, zero host
+  syncs (pinned by the inert tripwire in tests/test_serving_resilience.py);
+* **liveness + drain** — the scheduler thread heartbeats every loop
+  iteration (``health()``/``ready()`` probes read it; a ServingSupervisor
+  monitors it), and ``close(drain=True)`` stops admission, completes queued
+  and running work, then stops — the graceful-rolling-restart half of the
+  supervisor's crash/wedge recovery (serving/supervisor.py).
 
 Every scheduler action is a profiler span (``admit``/``schedule``/
 ``prefill``/``decode_step``/``page_alloc``/``evict``) with ``serve_*``
 counters, and the engine registers a flight-recorder context provider so
-crash dumps carry the in-flight request table.
+crash dumps carry the in-flight request table. Chaos points ``serve.crash``
+/ ``serve.wedge`` / ``serve.slow_step`` / ``serve.pool_corrupt``
+(fault/inject.py) fire at the scheduler step boundary when armed.
 """
 from __future__ import annotations
 
@@ -45,6 +66,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..fault import inject as _inject
 from ..framework import flags
 from ..profiler import counter_inc, flight
 from ..profiler.spans import span
@@ -52,7 +74,7 @@ from .pool import PagePool, TRASH_BLOCK
 
 __all__ = [
     "Engine", "EngineConfig", "RequestHandle", "ServeError",
-    "RequestCancelled",
+    "RequestCancelled", "DeadlineExceeded", "Overloaded",
 ]
 
 _engine_ids = itertools.count(1)
@@ -66,6 +88,25 @@ class RequestCancelled(ServeError):
     pass
 
 
+class DeadlineExceeded(ServeError):
+    """The request's ``deadline_s`` passed (or provably cannot be met) before
+    completion — shed by the scheduler at admission or a step boundary."""
+
+    def __init__(self, msg: str, request_id: Optional[int] = None):
+        super().__init__(msg)
+        self.request_id = request_id
+
+
+class Overloaded(ServeError):
+    """Fast-fail load shed: the submission queue hit ``FLAGS_serve_max_queue``
+    with ``FLAGS_serve_shed`` armed. ``retry_after_s`` is the Retry-After-style
+    backoff hint (estimated time for one queue slot to drain)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
 class EngineConfig:
     """Serving knobs. ``None`` fields resolve from the ``FLAGS_serve_*``
     registry at engine construction, so fleet-wide defaults are one
@@ -73,7 +114,7 @@ class EngineConfig:
 
     def __init__(self, block_size=None, num_blocks=None, max_batch=None,
                  max_seq_len=None, prefill_batch=None, int8=None,
-                 decode_buckets=None, seed=0):
+                 decode_buckets=None, seed=0, max_queue=None, shed=None):
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.max_batch = max_batch
@@ -82,6 +123,8 @@ class EngineConfig:
         self.int8 = int8
         self.decode_buckets = decode_buckets
         self.seed = seed
+        self.max_queue = max_queue
+        self.shed = shed
 
     def resolve(self, model_max_positions: int) -> "EngineConfig":
         def pick(v, name):
@@ -96,12 +139,20 @@ class EngineConfig:
         self.max_seq_len = min(max_seq, int(model_max_positions))
         if self.int8 is None:
             self.int8 = bool(flags.flag("FLAGS_serve_int8", False))
+        # 0 is the meaningful default here (unbounded queue), so only None
+        # falls back to the flag
+        self.max_queue = int(self.max_queue if self.max_queue is not None
+                             else flags.flag("FLAGS_serve_max_queue", 0))
+        if self.shed is None:
+            self.shed = bool(flags.flag("FLAGS_serve_shed", False))
         if self.block_size < 1 or self.num_blocks < 2 or self.max_batch < 1 \
                 or self.prefill_batch < 1 or self.max_seq_len < 1:
             raise ValueError(
                 "serving: block_size/max_batch/prefill_batch/max_seq_len "
                 ">= 1 and num_blocks >= 2 required"
             )
+        if self.max_queue < 0:
+            raise ValueError("serving: max_queue must be >= 0 (0 = unbounded)")
         if self.decode_buckets is None:
             b, buckets = 1, []
             while b < self.max_batch:
@@ -123,11 +174,11 @@ class _Request:
     __slots__ = (
         "id", "prompt", "max_new_tokens", "eos_token_id", "temperature",
         "tokens", "error", "done", "stream_q", "cancelled",
-        "t_submit", "t_done",
+        "t_submit", "t_done", "priority", "deadline",
     )
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id, temperature,
-                 stream):
+                 stream, priority=0, deadline=None):
         self.id = rid
         self.prompt = prompt  # list[int]
         self.max_new_tokens = int(max_new_tokens)
@@ -140,6 +191,33 @@ class _Request:
         self.cancelled = False
         self.t_submit = time.monotonic()
         self.t_done: Optional[float] = None
+        self.priority = int(priority)           # higher = more important
+        self.deadline = deadline                # absolute monotonic, or None
+
+
+def _finish(req: _Request, tokens=None, error=None, count=True) -> bool:
+    """Terminal state for a request: result lands, the stream closes, the
+    handle's waiters wake. Returns False when the request was already
+    finished — crash sweeps, supervisor relays, and the scheduler may race,
+    and first-writer-wins keeps that benign. Shared with the
+    ServingSupervisor, which finishes ORPHANED requests (their engine is
+    dead) without an Engine instance in hand. ``count=False`` skips the
+    lifecycle counters: a relay completing the ORIGINAL of a requeued
+    request would otherwise double-count the continuation the new engine
+    already counted."""
+    if req.done.is_set():
+        return False
+    req.tokens = list(tokens) if tokens is not None else None
+    req.error = error
+    req.t_done = time.monotonic()
+    if count:
+        counter_inc("serve_cancelled" if isinstance(error, RequestCancelled)
+                    else "serve_failed" if error is not None
+                    else "serve_retired")
+    if req.stream_q is not None:
+        req.stream_q.put(None)
+    req.done.set()
+    return True
 
 
 class _Seq:
@@ -288,14 +366,42 @@ class Engine:
         self._step_i = 0
         self._occ_live = 0
         self._occ_slots = 0
+        # resilience gauges (engine-thread writes, racy cross-thread reads by
+        # design): decode service-time EMA (compile steps excluded — it feeds
+        # deadline feasibility), completed-request latency EMA (Retry-After
+        # hints), and the scheduler-thread heartbeat that health()/the
+        # supervisor read
+        self._ema_step_s = 0.0
+        self._ema_req_s = 0.0
+        self._beat = time.monotonic()
+        # True while a FIRST-CALL compiled program is building (jit compile
+        # can dwarf a step): the supervisor widens its staleness limit 10x
+        # so a cold start is not misread as a wedge — a thread genuinely
+        # wedged inside a compile is still caught, just later
+        self._compiling = False
 
         # cross-thread state
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._waiting: "collections.deque[_Request]" = collections.deque()  # guarded_by: _cv
         self._stop = False  # guarded_by: _cv
+        self._draining = False  # guarded_by: _cv
         self._broken: Optional[BaseException] = None
         self._ids = itertools.count(1)
+        # once-true latches (set under _cv, read lock-free by the scheduler):
+        # the deadline sweep and the priority admission scan run ONLY after a
+        # deadline'd / prioritized request has ever been submitted — the
+        # unconfigured path stays a flag probe (inert tripwire)
+        self._deadline_seen = False
+        self._has_prio = False
+        # supervision hooks (set by ServingSupervisor; None/False = PR 11
+        # behavior exactly): a supervised crash leaves scheduler state for
+        # the supervisor to harvest instead of failing every handle, and the
+        # loop publishes serve.step phase records into the PR 8 watchdog
+        # progress table
+        self._supervised = False
+        self._watchdog = None
+        self._failed = threading.Event()
 
         # Both the flight registry and the scheduler thread hold only a
         # weakref: an abandoned (never-closed) engine stays collectable —
@@ -318,9 +424,20 @@ class Engine:
     # ------------------------------------------------------------------ API
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None, temperature: float = 0.0,
-               stream: bool = False) -> RequestHandle:
+               stream: bool = False, deadline_s: Optional[float] = None,
+               priority: int = 0, _shed_exempt: bool = False) -> RequestHandle:
         """Enqueue one request (any thread). ``temperature == 0`` is greedy.
-        ``stream=True`` additionally feeds the handle's iterator per token."""
+        ``stream=True`` additionally feeds the handle's iterator per token.
+        ``deadline_s`` (seconds from now) attaches a completion deadline: the
+        scheduler sheds the request with :class:`DeadlineExceeded` — raised
+        from ``result()`` — once it expires or provably cannot finish in
+        time. ``priority`` (higher = more important, default 0) orders
+        admission and inverts eviction (priority-then-youngest). Under the
+        shed policy (``max_queue`` + ``shed``) a full queue fast-fails this
+        call with :class:`Overloaded` instead of queuing without bound —
+        except for ``_shed_exempt`` submissions (supervisor-internal:
+        requeued work the engine already accepted once must not be shed by
+        its own recovery)."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("serving: empty prompt")
@@ -328,6 +445,8 @@ class Engine:
             # prefill always yields the first generated token, so a 0-token
             # budget cannot honor the prompt+max_new output contract
             raise ValueError("serving: max_new_tokens must be >= 1")
+        if deadline_s is not None and float(deadline_s) <= 0.0:
+            raise ValueError("serving: deadline_s must be positive")
         total = len(prompt) + int(max_new_tokens)
         if total > self.config.max_seq_len:
             raise ValueError(
@@ -339,11 +458,34 @@ class Engine:
                 "serving: request needs more KV blocks than the whole pool; "
                 "raise FLAGS_serve_num_blocks"
             )
+        cfg = self.config
         with self._cv:
             if self._stop or self._broken is not None:
                 raise ServeError("serving engine is closed") from self._broken
+            if self._draining:
+                raise ServeError(
+                    "serving engine is draining (close(drain=True)); "
+                    "submit to its replacement"
+                )
+            if cfg.shed and not _shed_exempt and cfg.max_queue > 0 \
+                    and len(self._waiting) >= cfg.max_queue:
+                counter_inc("serve_shed")
+                hint = round(max(0.05, len(self._waiting)
+                                 * (self._ema_req_s or 0.1) / cfg.max_batch), 3)
+                raise Overloaded(
+                    f"serving queue full ({len(self._waiting)} >= "
+                    f"max_queue={cfg.max_queue}); retry after ~{hint}s",
+                    retry_after_s=hint,
+                )
             req = _Request(next(self._ids), prompt, max_new_tokens,
-                           eos_token_id, temperature, stream)
+                           eos_token_id, temperature, stream,
+                           priority=priority,
+                           deadline=(time.monotonic() + float(deadline_s))
+                           if deadline_s is not None else None)
+            if req.deadline is not None:
+                self._deadline_seen = True
+            if req.priority != 0:
+                self._has_prio = True
             self._waiting.append(req)
             counter_inc("serve_requests")
             self._cv.notify()
@@ -371,18 +513,109 @@ class Engine:
             "decode_steps": self._step_i,
         }
 
-    def close(self, timeout: float = 30.0) -> None:
-        """Stop the engine thread; outstanding requests fail with
-        ``ServeError``. Idempotent."""
+    def health(self) -> dict:
+        """Liveness probe (any thread): scheduler-thread aliveness, heartbeat
+        age, and failure state. ``ok`` is the single bit an external monitor
+        should alarm on; the rest is diagnosis."""
+        alive = self._thread.is_alive()
+        with self._lock:
+            depth = len(self._waiting)
+            draining = self._draining
+            stopped = self._stop
+        beat_age = time.monotonic() - self._beat
+        # heartbeat staleness folds into ok: an alive-but-wedged scheduler
+        # must flip the probe even without a supervisor. Same staleness
+        # contract as the supervisor: watchdog_s, 10x while a first-call
+        # compile runs
+        thr = max(1.0, float(flags.flag("FLAGS_serve_watchdog_s", 10.0) or 10.0))
+        stale = beat_age > thr * (10.0 if self._compiling else 1.0)
+        return {
+            "ok": alive and self._broken is None and not stopped and not stale,
+            "thread_alive": alive,
+            "beat_age_s": round(beat_age, 3),
+            "stale": stale,
+            "broken": repr(self._broken) if self._broken is not None else None,
+            "draining": draining,
+            "queue_depth": depth,
+            "running": len(self._running),
+            "pages_free": self._pool.free_blocks,
+        }
+
+    def ready(self) -> bool:
+        """Readiness probe: accepting new submissions right now — healthy,
+        not draining, and (under the shed policy) queue below the cap. The
+        rolling-restart contract: flip a replica's traffic away when this
+        goes False, then ``close(drain=True)`` it."""
+        h = self.health()
+        if not h["ok"] or h["draining"]:
+            return False
+        cfg = self.config
+        if cfg.shed and cfg.max_queue > 0 and h["queue_depth"] >= cfg.max_queue:
+            return False
+        return True
+
+    def close(self, timeout: float = 30.0, drain: bool = False) -> None:
+        """Stop the engine thread. Plain ``close()`` fails outstanding
+        requests with ``ServeError``; ``close(drain=True)`` first stops
+        admission (``submit`` raises, ``ready()`` goes False) and lets
+        queued + running work complete within ``timeout`` — the graceful
+        half of a rolling restart. A ``join`` that times out (wedged
+        scheduler thread) marks the engine broken and fails every
+        outstanding handle instead of returning with clients blocked
+        forever in ``result()``. Idempotent."""
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        on_sched_thread = threading.current_thread() is self._thread
+        if drain:
+            with self._cv:
+                self._draining = True
+                self._cv.notify()
+            if not on_sched_thread:
+                self._thread.join(max(0.0, deadline - time.monotonic()))
         with self._cv:
             self._stop = True
             self._cv.notify()
         # provider first: it must go even when the join below is skipped
         # (close() can run ON the scheduler thread — __del__ fires there
-        # when the loop's deref holds the last reference)
+        # when the loop's deref holds the last reference); same for this
+        # engine's watchdog unit record — stale units must not outlive it
         flight.remove_context_provider(self._provider)
-        if threading.current_thread() is not self._thread:
-            self._thread.join(timeout)
+        if self._watchdog is not None:
+            try:
+                self._watchdog.remove_unit(self._provider)
+            except Exception:
+                pass
+        if not on_sched_thread:
+            # drain path: the drain join above may have consumed the whole
+            # budget on legitimate work — give the post-stop join a real
+            # floor (a healthy thread exits within ~one step of _stop), so
+            # a merely-slow drain is not misdiagnosed as a wedged scheduler
+            self._thread.join(max(2.0 if drain else 0.1,
+                                  deadline - time.monotonic()))
+            if self._thread.is_alive():
+                counter_inc("serve_wedged_close")
+                self._broken = self._broken or ServeError(
+                    f"serving engine scheduler thread wedged: close() join "
+                    f"timed out after {timeout}s"
+                )
+        # Wedged join, a supervised crash whose supervisor never harvested,
+        # or __del__ firing on the scheduler thread all leave handles
+        # pending — fail them (handle state only, no pool mutation: a
+        # wedged thread may still own the pool). No-op on a clean shutdown.
+        self._fail_outstanding(self._broken or ServeError("serving engine closed"))
+
+    def _fail_outstanding(self, err: BaseException) -> None:
+        """Fail every pending handle without touching the page pool — safe
+        to run from any thread, idempotent per request via the done-guard
+        in ``_finish``."""
+        with self._cv:
+            waiting = list(self._waiting)
+            self._waiting.clear()
+        seqs = list(self._running) + list(self._resume) + list(self._admitting)
+        for req in waiting + [s.req for s in seqs]:
+            try:
+                self._finish_request(req, error=ServeError(str(err)))
+            except Exception:
+                pass
 
     def __enter__(self):
         return self
@@ -400,21 +633,34 @@ class Engine:
     # ------------------------------------------------------- engine thread
     def _run_once(self) -> bool:
         """One scheduler iteration (bounded idle wait). True = stopped."""
+        self._beat = time.monotonic()  # heartbeat: health() / supervisor
         with self._cv:
-            if not self._stop and not self._waiting and not self._running \
-                    and not self._resume:
+            idle = not (self._waiting or self._running or self._resume)
+            if self._draining and idle:
+                self._stop = True  # drain complete: fall through to stop
+            if not self._stop and idle:
                 self._cv.wait(timeout=0.5)
             if self._stop:
                 return True
             has_work = bool(self._waiting or self._running or self._resume)
         if has_work:
             self._step()
+        if self._watchdog is not None:
+            # supervised engines ride the PR 8 progress table: the scheduler
+            # thread's serving step/phase lands in every crash dump's
+            # cross-rank view (rate-limited inside publish)
+            self._watchdog.publish(step=self._step_i, phase="serve.step",
+                                   unit=self._provider)
         return False
 
     def _step(self):
+        if _inject._armed:
+            self._chaos_step()
         with span("schedule", step=self._step_i,
                   running=len(self._running)) as sp:
             self._drain_cancels()
+            if self._deadline_seen:
+                self._shed_sweep()
             # track mid-prefill sequences so a loop crash fails their
             # handles instead of orphaning them (they are in neither
             # _waiting nor _running until prefill lands); cleared only on
@@ -426,6 +672,72 @@ class Engine:
             if self._running:
                 self._decode()
             sp.set(running_after=len(self._running))
+
+    def _chaos_step(self):
+        """``serve.*`` chaos points, consulted once per scheduler step while
+        injection is armed (the unarmed path is one module-attribute probe in
+        ``_step``). ``serve.crash`` raises out of the loop, ``serve.wedge``
+        hangs the scheduler thread (forever unless ``ms=`` bounds it),
+        ``serve.slow_step`` is a straggler delay, ``serve.pool_corrupt``
+        breaks pool conservation so a later free raises."""
+        step = self._step_i
+        if _inject.should_fire("serve.slow_step", step=step):
+            time.sleep(_inject.point_cfg("serve.slow_step").get("ms", 100) / 1000.0)
+        if _inject.should_fire("serve.pool_corrupt", step=step):
+            self._pool.damage()
+        if _inject.should_fire("serve.wedge", step=step):
+            ms = _inject.point_cfg("serve.wedge").get("ms")
+            if ms:
+                time.sleep(ms / 1000.0)
+            else:
+                _inject._hang("serve.wedge")
+        if _inject.should_fire("serve.crash", step=step):
+            raise ServeError(f"injected serve.crash at engine step {step}")
+
+    def _shed_sweep(self):
+        """Step-boundary deadline enforcement. Runs only once a deadline'd
+        request has ever been submitted (``_deadline_seen``) — the
+        unconfigured path never reaches here. Expired requests fail wherever
+        they sit; a queued request that cannot meet its deadline even if
+        admitted NOW (prefill + full token budget at the measured decode-step
+        EMA) is shed at admission — rejecting early is cheaper than paying a
+        prefill it will abandon."""
+        now = time.monotonic()
+        ema = self._ema_step_s
+        shed = []
+        with self._cv:
+            for req in [r for r in self._waiting if r.deadline is not None]:
+                eta = (1 + req.max_new_tokens) * ema
+                if now >= req.deadline:
+                    self._waiting.remove(req)
+                    shed.append((req, f"expired in queue "
+                                 f"({now - req.deadline:.3f}s late)"))
+                elif now + eta > req.deadline:
+                    self._waiting.remove(req)
+                    shed.append((req, f"doomed at admission: needs "
+                                 f"~{eta:.3f}s but the deadline is in "
+                                 f"{req.deadline - now:.3f}s"))
+        for req, why in shed:
+            counter_inc("serve_deadline_shed")
+            self._finish_request(req, error=DeadlineExceeded(
+                f"request {req.id} {why}", request_id=req.id))
+        for seq in [s for s in self._running
+                    if s.req.deadline is not None
+                    and now >= s.req.deadline]:
+            counter_inc("serve_deadline_expired")
+            self._retire(seq, error=DeadlineExceeded(
+                f"request {seq.req.id} deadline expired mid-decode "
+                f"({seq.generated}/{seq.req.max_new_tokens} generated)",
+                request_id=seq.req.id))
+        for seq in [s for s in self._resume
+                    if s.req.deadline is not None
+                    and now >= s.req.deadline]:
+            self._resume.remove(seq)
+            counter_inc("serve_deadline_expired")
+            self._finish_request(seq.req, error=DeadlineExceeded(
+                f"request {seq.req.id} deadline expired while preempted "
+                f"({seq.generated}/{seq.req.max_new_tokens} generated)",
+                request_id=seq.req.id))
 
     # -- admission ----------------------------------------------------------
     def _make_prefill_buckets(self) -> Sequence[int]:
@@ -468,13 +780,29 @@ class Engine:
                 seq.blocks = blocks
                 admitted.append(seq)
             self._resume = still_resume
-            while len(self._running) + len(admitted) < self.config.max_batch:
+            # ONE ordered snapshot per admission pass, not an O(queue) scan
+            # per batch slot: strict priority order, FIFO within a class,
+            # and only the best remaining candidate is considered at each
+            # slot — if it doesn't fit, nothing behind it bypasses it (no
+            # starvation of large high-priority requests). Submits landing
+            # mid-pass wait for the next step (ms away). Concurrent removal
+            # (close/harvest while the engine is dying) is handled by the
+            # remove() ValueError guards below.
+            with self._cv:
+                if self._has_prio and len(self._waiting) > 1:
+                    cand = sorted(self._waiting,
+                                  key=lambda r: (-r.priority, r.id))
+                else:
+                    cand = list(self._waiting)
+            for req in cand:
+                if len(self._running) + len(admitted) >= self.config.max_batch:
+                    break
                 with self._cv:
-                    req = self._waiting[0] if self._waiting else None
-                    if req is None:
-                        break
                     if req.cancelled:
-                        self._waiting.popleft()
+                        try:
+                            self._waiting.remove(req)
+                        except ValueError:
+                            continue  # already drained elsewhere
                         self._finish_request(req, error=RequestCancelled(
                             f"request {req.id} cancelled"))
                         continue
@@ -484,7 +812,11 @@ class Engine:
                     if blocks is None:
                         counter_inc("serve_backpressure")
                         break
-                    self._waiting.popleft()
+                    try:
+                        self._waiting.remove(req)
+                    except ValueError:  # raced away mid-pass — undo the grant
+                        self._pool.free(blocks)
+                        continue
                 seq = _Seq(req, list(req.prompt))
                 seq.blocks = blocks
                 admitted.append(seq)
@@ -506,7 +838,13 @@ class Engine:
                 chunk = group[i:i + bw]
                 with span("prefill", bucket_t=t_bucket, bucket_b=bw,
                           rows=len(chunk)):
+                    # heartbeat before a potentially-long op (first-call jit
+                    # compile): the supervisor's staleness clock starts HERE,
+                    # so only a genuinely wedged op trips it
+                    self._beat = time.monotonic()
+                    n_fns = len(self._fns)
                     fn = self._get_fn("prefill", bw, t_bucket)
+                    self._compiling = len(self._fns) != n_fns
                     ids = np.zeros((bw, t_bucket), np.int32)
                     lens = np.ones((bw,), np.int32)
                     tables = np.full((bw, self._max_blocks), TRASH_BLOCK,
@@ -522,6 +860,11 @@ class Engine:
                     )
                     counter_inc("serve_prefills")
                     rows = np.asarray(logits)
+                    # beat BEFORE dropping the compile grace: a monitor poll
+                    # between the two would see a stale beat at the 1x limit
+                    # and declare a spurious wedge after a long compile
+                    self._beat = time.monotonic()
+                    self._compiling = False
                     for r, s in enumerate(chunk):
                         self._append_token(s, self._sample_host(rows[r], s.req))
                         if not s.req.done.is_set():
@@ -541,8 +884,11 @@ class Engine:
     # -- decode --------------------------------------------------------------
     def _grow_blocks(self):
         """Every live sequence needs block ``pos // block_size`` mapped
-        before the step; pool exhaustion preempts the youngest peer (evict →
-        requeue for re-prefill) — backpressure, never failure."""
+        before the step; pool exhaustion preempts a peer (evict → requeue
+        for re-prefill) — backpressure, never failure. Victim selection is
+        priority-then-youngest: the lowest-priority peer goes first, ties
+        broken by the youngest request; a grower never evicts a
+        higher-priority peer — it preempts ITSELF instead."""
         for seq in list(self._running):
             if seq not in self._running:
                 continue  # evicted by an earlier iteration
@@ -561,7 +907,12 @@ class Engine:
                         f"page pool exhausted by a single sequence "
                         f"(request {seq.req.id})"
                     )
-                self._evict(victims[-1])
+                victim = min(victims,
+                             key=lambda s: (s.req.priority, -s.req.id))
+                if victim.req.priority > seq.req.priority:
+                    self._evict(seq)
+                    break
+                self._evict(victim)
 
     def _evict(self, seq: _Seq):
         with span("evict", request=seq.req.id, generated=seq.generated):
@@ -588,14 +939,27 @@ class Engine:
             toks[r] = s.tokens[-1]
             temps[r] = s.req.temperature
         self._key, sub = jax.random.split(self._key)
+        n_fns = len(self._fns)
         with span("decode_step", bucket=bb, rows=n, step=self._step_i):
+            self._beat = time.monotonic()  # staleness clock covers this op
             fn = self._get_fn("decode", bb)
+            self._compiling = len(self._fns) != n_fns
+            t0 = time.monotonic()
             self._kpool, self._vpool, nxt = fn(
                 self._compute_params, self._kpool, self._vpool,
                 jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(toks),
                 jnp.asarray(temps), sub,
             )
         nxt = np.asarray(nxt)
+        self._beat = time.monotonic()  # beat before dropping compile grace
+        self._compiling = False
+        # decode service-time EMA feeds deadline feasibility + Retry-After
+        # hints; compile steps (a new _fns entry this step) are excluded —
+        # they would make every early deadline look doomed
+        if len(self._fns) == n_fns:
+            dt = time.monotonic() - t0
+            self._ema_step_s = (dt if not self._ema_step_s
+                                else 0.8 * self._ema_step_s + 0.2 * dt)
         self._step_i += 1
         self._occ_live += n
         self._occ_slots += bb
@@ -628,17 +992,12 @@ class Engine:
         self._finish_request(seq.req, tokens=seq.tokens, error=error)
 
     def _finish_request(self, req: _Request, tokens=None, error=None):
-        if req.done.is_set():
-            return  # the crash sweep may see a sequence twice
-        req.tokens = list(tokens) if tokens is not None else None
-        req.error = error
-        req.t_done = time.monotonic()
-        counter_inc("serve_cancelled" if isinstance(error, RequestCancelled)
-                    else "serve_failed" if error is not None
-                    else "serve_retired")
-        if req.stream_q is not None:
-            req.stream_q.put(None)
-        req.done.set()
+        if _finish(req, tokens=tokens, error=error) and error is None:
+            # completed-request latency EMA drives the Overloaded
+            # retry_after_s hint
+            lat = req.t_done - req.t_submit
+            self._ema_req_s = (lat if not self._ema_req_s
+                               else 0.8 * self._ema_req_s + 0.2 * lat)
 
     # -- cancellation / teardown ---------------------------------------------
     def _cancel(self, req: _Request):
@@ -784,7 +1143,15 @@ def _engine_loop(wr):
                 counter_inc("serve_engine_errors")
                 flight.dump("serving_loop_error", extra={"exception": repr(e)})
             finally:
-                eng._shutdown()
+                if eng._supervised:
+                    # leave queued/in-flight scheduler state intact for the
+                    # supervisor to harvest (requeue onto the restarted
+                    # engine, or fail structurally) — _shutdown here would
+                    # fail handles the restart could still save. The kick
+                    # wakes the monitor without waiting out its poll.
+                    eng._failed.set()
+                else:
+                    eng._shutdown()
             return
         if stopped:
             eng._shutdown()

@@ -67,6 +67,18 @@ class PagePool:
             self._free.append(b)
         counter_inc("serve_pages_freed", len(ids))
 
+    def damage(self) -> None:
+        """Chaos-only (``serve.pool_corrupt`` injection point): deliberately
+        break conservation so the next ``free()`` of the damaged block (or
+        ``check()``) raises — the engine's crash-containment path must turn
+        a corrupt pool into failed-or-requeued handles, never a hang."""
+        if self._owned:
+            lost = next(iter(self._owned))
+            self._owned.discard(lost)
+        elif self._free:
+            self._free.append(self._free[-1])
+        counter_inc("serve_pool_damaged")
+
     def check(self) -> None:
         """Conservation invariant: every non-trash block is exactly one of
         free or owned."""

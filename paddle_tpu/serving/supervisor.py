@@ -1,0 +1,516 @@
+"""Serving supervision — bounded-time crash/wedge detection + engine restart.
+
+PR 8 gave training the production failure stance: progress heartbeats, a
+deadline watchdog, chaos injection, coordinated recovery. The serving engine
+needs the same discipline — a crashed or wedged scheduler thread must not
+strand every client handle, and a restart must not change a single greedy
+token. :class:`ServingSupervisor` wraps an :class:`~.engine.Engine` with:
+
+* **liveness probes** — the scheduler thread heartbeats (``Engine._beat``)
+  every loop iteration and before every potentially-long compiled-program
+  call; a supervised engine also publishes ``serve.step`` phase records
+  through the PR 8 watchdog progress table (``distributed/watchdog.py``
+  ``publish(unit=...)``), so cross-rank post-mortems carry serving progress
+  next to training progress;
+* **bounded-time detection** — a monitor thread (the supervisor's ONLY
+  thread; unsupervised engines keep the PR 11 zero-extra-thread profile)
+  watches thread aliveness and heartbeat staleness and declares the engine
+  failed within ``FLAGS_serve_watchdog_s``: a *crash* (the loop raised — the
+  engine kicks the monitor immediately via ``_failed``) or a *wedge* (thread
+  alive, heartbeat stale past 3/4 of the watchdog deadline);
+* **recovery** — a fresh Engine over the same model/pool config. After a
+  CRASH the dead loop's state is frozen and safe to adopt: queued requests
+  and in-flight sequences are **requeued**, mid-decode sequences continuing
+  from their accumulated tokens through the engine's existing re-prefill
+  path — greedy outputs stay **bit-identical** to an uninterrupted run
+  (sampled continuations are valid but re-seeded). After a WEDGE the
+  abandoned thread may still own its sequences, so in-flight work **fails**
+  with a structured ``ServeError`` (never a hang) while queued requests —
+  untouched by the wedged loop — are requeued. ``max_restarts`` exhaustion
+  fails everything and marks the supervisor broken;
+* **probes + drain** — ``health()``/``ready()`` aggregate engine liveness
+  with supervisor state for rolling-restart orchestration;
+  ``close(drain=True)`` stops admission and completes outstanding work
+  before stopping (the engine's drain mode).
+
+Chaos coverage: ``serve.crash`` / ``serve.wedge`` / ``serve.slow_step`` /
+``serve.pool_corrupt`` (fault/inject.py) drive the recovery paths in
+tests/test_serving_chaos.py; the tier-1 pins live in
+tests/test_serving_resilience.py.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+import time
+import weakref
+from typing import List, Optional, Tuple
+
+from ..framework import flags
+from ..profiler import counter_inc, flight
+from ..profiler.spans import span
+from .engine import (
+    DeadlineExceeded, Engine, RequestHandle, ServeError, _finish,
+)
+
+__all__ = ["ServingSupervisor"]
+
+_sup_ids = itertools.count(1)
+
+
+def _drain_stream(req, inner) -> None:
+    """Forward the continuation's streamed tokens into the original
+    request's stream queue (skipping the inner sentinel — the original's is
+    sent by its own ``_finish``)."""
+    if req.stream_q is None or inner.stream_q is None:
+        return
+    while True:
+        try:
+            item = inner.stream_q.get_nowait()
+        except _queue.Empty:
+            return
+        if item is not None:
+            req.stream_q.put(item)
+
+
+def _relay_many(pairs) -> None:
+    """ONE relay thread per recovery (not per request — a crash harvested
+    with hundreds of queued requests must not burst hundreds of threads):
+    a polling multiplexer that forwards each continuation's stream tokens
+    and terminal state into the client's ORIGINAL request, and propagates
+    late cancels (the engine that would have drained them is gone). A
+    continuation caught by a SECOND crash resolves through the next
+    recovery's relay — this loop just keeps waiting on its done event."""
+    pending = list(pairs)
+    while pending:
+        still = []
+        for req, handle in pending:
+            inner = handle._req
+            if req.cancelled and not inner.cancelled:
+                handle.cancel()
+            _drain_stream(req, inner)
+            if inner.done.is_set():
+                # the sentinel lands BEFORE done.set(): one more drain
+                # cannot miss tokens. count=False — the new engine already
+                # counted the continuation's outcome; counting the original
+                # too would double serve_retired/serve_failed per recovered
+                # request (serve_relayed tracks these instead)
+                _drain_stream(req, inner)
+                if inner.error is not None:
+                    _finish(req, error=inner.error, count=False)
+                else:
+                    _finish(req, tokens=inner.tokens, count=False)
+                counter_inc("serve_relayed")
+            else:
+                still.append((req, handle))
+        pending = still
+        if pending:
+            time.sleep(0.02)
+
+
+def _monitor_loop(wr) -> None:
+    """Monitor thread body. Weakref discipline (the engine-loop pattern): an
+    abandoned supervisor stays GC-collectable — ``__del__`` closes it and
+    the next deref here returns None, ending the thread."""
+    while True:
+        sup = wr()
+        if sup is None or sup._stop.is_set():
+            return
+        with sup._lock:
+            eng = sup._engine
+            broken = sup._broken
+        kind = err = None
+        if eng is not None and broken is None and not eng._stop:
+            if eng._failed.is_set() or not eng._thread.is_alive():
+                kind = "crash"
+                err = eng._broken or ServeError(
+                    "serving engine scheduler thread exited unexpectedly")
+            else:
+                age = time.monotonic() - eng._beat
+                # a first-call jit compile legitimately dwarfs a step: give
+                # it 10x before declaring a wedge (a thread wedged INSIDE
+                # the compile is still caught, just later)
+                limit = sup._stale_s * (10.0 if eng._compiling else 1.0)
+                if age > limit:
+                    kind = "wedge"
+                    err = ServeError(
+                        f"serving engine scheduler thread wedged: heartbeat "
+                        f"stale {age:.2f}s (watchdog {sup.watchdog_s}s"
+                        + (", compile grace 10x exhausted)" if eng._compiling
+                           else ")"))
+        if kind is not None:
+            try:
+                sup._recover(eng, kind, err)
+            except Exception as e:  # a failed recovery breaks the supervisor
+                with sup._lock:
+                    if sup._broken is None:
+                        sup._broken = e
+                sup._fail_all(ServeError(f"serving recovery failed: {e!r}"))
+            continue  # re-evaluate immediately against the fresh engine
+        poll = sup._poll_s
+        # wait on the crash kick only while it can still trigger a recovery:
+        # after exhaustion (broken set) or a deliberate engine stop,
+        # eng._failed stays set forever and waiting on it would busy-spin
+        # this thread at 100% CPU until close()
+        evt = (eng._failed if eng is not None and broken is None
+               and not eng._stop and not eng._failed.is_set()
+               else sup._stop)
+        del sup, eng
+        # a crash kick wakes us immediately; otherwise poll for staleness
+        evt.wait(timeout=poll)
+
+
+class ServingSupervisor:
+    """Crash/wedge supervision over a serving :class:`Engine` (front-door
+    compatible: ``submit``/``generate``/``stats`` delegate to the current
+    engine and survive restarts)."""
+
+    def __init__(self, model, config=None, max_restarts: int = 3,
+                 watchdog_s: Optional[float] = None, **overrides):
+        self.watchdog_s = float(
+            watchdog_s if watchdog_s is not None
+            else flags.flag("FLAGS_serve_watchdog_s", 10.0))
+        if self.watchdog_s < 1.0:
+            # the engine's idle loop only refreshes its heartbeat every
+            # 0.5s (cv.wait timeout): a sub-second staleness threshold
+            # would flag a perfectly idle engine as wedged
+            raise ValueError("supervisor: watchdog_s must be >= 1.0")
+        # detect within watchdog_s: staleness trips at 3/4 of the deadline,
+        # the poll adds at most 1/5 — worst case ~0.95 * watchdog_s
+        self._stale_s = 0.75 * self.watchdog_s
+        self._poll_s = max(0.02, min(0.5, self.watchdog_s / 5.0))
+        self.max_restarts = int(max_restarts)
+        self._model = model
+        self._config = config
+        self._overrides = dict(overrides)
+        self._lock = threading.Lock()
+        self._engine: Optional[Engine] = self._spawn()  # guarded_by: _lock
+        self._restarts = 0                              # guarded_by: _lock
+        self._broken: Optional[BaseException] = None    # guarded_by: _lock
+        self._relays: List[threading.Thread] = []       # guarded_by: _lock
+        self._stop = threading.Event()
+        self._provider = f"serving_supervisor_{next(_sup_ids)}"
+        wr = weakref.ref(self)
+        flight.add_context_provider(
+            self._provider,
+            lambda _wr=wr: (
+                s._flight_context() if (s := _wr()) is not None
+                else {"closed": True}
+            ),
+        )
+        self._monitor = threading.Thread(
+            target=_monitor_loop, args=(wr,), daemon=True,
+            name=self._provider)
+        self._monitor.start()
+
+    def _spawn(self) -> Engine:
+        eng = Engine(self._model, config=self._config, **self._overrides)
+        eng._supervised = True
+        try:
+            from ..distributed import watchdog as _wd
+
+            eng._watchdog = _wd
+        except Exception:
+            eng._watchdog = None
+        return eng
+
+    # ------------------------------------------------------------------ API
+    def submit(self, prompt_ids, **kw) -> RequestHandle:
+        """Front door (any thread): delegates to the current engine, waiting
+        out a concurrent restart (bounded by ~2x the watchdog deadline)
+        instead of surfacing the dead engine's ServeError. Structured
+        rejections — Overloaded, DeadlineExceeded, validation — pass
+        through untouched."""
+        deadline = time.monotonic() + 2.0 * self.watchdog_s + 5.0
+        while True:
+            with self._lock:
+                broken, eng = self._broken, self._engine
+            if broken is not None or eng is None:
+                raise ServeError(
+                    "serving supervisor is broken") from broken
+            try:
+                return eng.submit(prompt_ids, **kw)
+            except ServeError:
+                if eng._broken is None and not eng._stop:
+                    raise  # a real rejection (Overloaded/draining), not a death
+                if self._stop.is_set() or time.monotonic() >= deadline:
+                    raise
+                time.sleep(self._poll_s)  # the monitor is swapping engines
+
+    def generate(self, prompt_ids, **kw):
+        return self.submit(prompt_ids, **kw).result()
+
+    def stats(self) -> dict:
+        with self._lock:
+            eng, restarts = self._engine, self._restarts
+        st = eng.stats() if eng is not None else {}
+        st["restarts"] = restarts
+        return st
+
+    @property
+    def restarts(self) -> int:
+        with self._lock:
+            return self._restarts
+
+    def health(self) -> dict:
+        """Engine liveness + supervisor state; ``ok`` requires both."""
+        with self._lock:
+            eng, restarts, broken = self._engine, self._restarts, self._broken
+        h = eng.health() if eng is not None else {"ok": False}
+        h.update(
+            restarts=restarts,
+            max_restarts=self.max_restarts,
+            watchdog_s=self.watchdog_s,
+            supervisor_ok=broken is None,
+        )
+        h["ok"] = bool(h.get("ok") and broken is None)
+        return h
+
+    def ready(self) -> bool:
+        with self._lock:
+            if self._broken is not None or self._engine is None:
+                return False
+            eng = self._engine
+        return eng.ready()
+
+    def close(self, timeout: float = 30.0, drain: bool = False) -> None:
+        """Stop monitoring, then the engine (``drain=True`` completes queued
+        and running work first); outstanding recovery relays are joined.
+        Idempotent."""
+        self._stop.set()
+        if self._monitor is not None \
+                and self._monitor is not threading.current_thread():
+            self._monitor.join(timeout=max(1.0, 2.0 * self._poll_s))
+        # close every engine we can see — looped, because a recovery that
+        # was mid-flight when _stop landed may still swap in a replacement
+        # (its install path re-checks _stop, so this converges in <= 2)
+        closed = set()
+        while True:
+            with self._lock:
+                eng = self._engine
+            if eng is None or id(eng) in closed:
+                break
+            closed.add(id(eng))
+            eng.close(timeout=timeout, drain=drain)
+        with self._lock:
+            relays = list(self._relays)
+        for t in relays:  # their continuation handles just failed/finished
+            t.join(timeout=2.0)
+        flight.remove_context_provider(self._provider)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close(timeout=2.0)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- recovery
+    def _recover(self, old: Engine, kind: str, err: BaseException) -> None:
+        with self._lock:
+            if self._engine is not old or self._stop.is_set():
+                return  # stale detection: already recovered / closing
+            exhausted = self._restarts >= self.max_restarts
+            if exhausted:
+                self._broken = ServeError(
+                    f"serving supervisor: max_restarts={self.max_restarts} "
+                    f"exhausted ({err})")
+            else:
+                self._restarts += 1
+            restarts = self._restarts
+        counter_inc("serve_wedge_detected" if kind == "wedge"
+                    else "serve_crash_detected")
+        # post-mortem BEFORE quarantining: the old engine's context provider
+        # still reports its in-flight table
+        try:
+            flight.dump(
+                f"serving_supervisor_{kind}",
+                extra={"reason": str(err), "restarts": restarts,
+                       "exhausted": exhausted},
+            )
+        except Exception:
+            pass
+        # quarantine: a late-resuming BOUNDED wedge must exit at its next
+        # loop check instead of double-driving a restarted request's stream
+        old._broken = old._broken or err
+        with old._cv:
+            old._stop = True
+        flight.remove_context_provider(old._provider)
+        if old._watchdog is not None:
+            try:  # the dead engine's progress-table unit goes with it
+                old._watchdog.remove_unit(old._provider)
+            except Exception:
+                pass
+        work = self._harvest(old, kind, err)
+        if exhausted:
+            for req, _prefix, why in work:
+                _finish(req, error=why or ServeError(
+                    f"serving supervisor gave up after "
+                    f"{self.max_restarts} restarts: {err}"))
+            return
+        with span("supervise_restart", kind=kind, restarts=restarts,
+                  work=len(work)):
+            try:
+                self._restart(work, restarts)
+            except BaseException as e:
+                # the harvest already emptied the old engine's lists, so
+                # nothing else can ever finish these handles: a failed
+                # restart (e.g. OOM respawning the engine) must fail them
+                # here or clients block forever in result(). Done-guard
+                # makes this a no-op for entries that already resolved.
+                for req, _prefix, why in work:
+                    _finish(req, error=why or ServeError(
+                        f"serving engine restart failed: {e!r}"))
+                raise  # the monitor records the supervisor as broken
+
+    def _restart(self, work, restarts: int) -> None:
+        new = self._spawn()
+        with self._lock:
+            # close() may have raced this recovery (it only waits ~1s
+            # for the monitor): installing the replacement after close()
+            # returned would leak a live scheduler thread past shutdown
+            aborted = self._stop.is_set()
+            if not aborted:
+                self._engine = new
+        if aborted:
+            new.close(timeout=5.0)
+            for req, _prefix, why in work:
+                _finish(req, error=why or ServeError(
+                    "serving supervisor closed during recovery"))
+            return
+        counter_inc("serve_restarts")
+        pairs = []
+        for req, prefix, why in work:
+            if why is not None:
+                _finish(req, error=why)
+            else:
+                pair = self._requeue(new, req, prefix)
+                if pair is not None:
+                    pairs.append(pair)
+        if pairs:
+            t = threading.Thread(
+                target=_relay_many, args=(pairs,), daemon=True,
+                name=f"serve-relay-r{restarts}")
+            with self._lock:
+                self._relays = [r for r in self._relays
+                                if r.is_alive()] + [t]
+            t.start()
+
+    def _harvest(self, old: Engine, kind: str,
+                 err: BaseException) -> List[Tuple[object, Optional[list], Optional[BaseException]]]:
+        """Adopt the failed engine's request state: ``(request,
+        accumulated_tokens_or_None, fail_error_or_None)`` per pending
+        request. A crash freezes the loop's state (the thread is dead), so
+        everything requeues; a wedged thread may still hold its in-flight
+        sequences, so those fail structurally while the untouched queue
+        requeues."""
+        with old._cv:
+            queued = list(old._waiting)
+            old._waiting.clear()
+        seqs = list(old._admitting) + list(old._running) + list(old._resume)
+        if kind == "crash":
+            old._running, old._resume, old._admitting = [], [], []
+        now = time.monotonic()
+        work: List[Tuple[object, Optional[list], Optional[BaseException]]] = []
+        # a crash inside _prefill leaves landed rows in BOTH _admitting and
+        # _running (the same _Seq object) — dedup by request id or a stream
+        # would get two relays pushing into one queue
+        seen = set()
+        for req in queued:
+            if req.done.is_set() or req.id in seen:
+                continue
+            seen.add(req.id)
+            if req.deadline is not None and now >= req.deadline:
+                work.append((req, None, DeadlineExceeded(
+                    f"request {req.id} deadline expired during engine "
+                    f"recovery", request_id=req.id)))
+            else:
+                work.append((req, None, None))
+        for s in seqs:
+            req = s.req
+            if req.done.is_set() or req.id in seen:
+                continue
+            seen.add(req.id)
+            if kind == "wedge":
+                work.append((req, None, ServeError(
+                    f"request {req.id} lost: engine scheduler thread wedged "
+                    f"mid-flight ({s.generated}/{req.max_new_tokens} "
+                    f"generated)")))
+            elif req.deadline is not None and now >= req.deadline:
+                work.append((req, None, DeadlineExceeded(
+                    f"request {req.id} deadline expired during engine "
+                    f"recovery", request_id=req.id)))
+            else:
+                work.append((req, list(s.tokens), None))
+        return work
+
+    def _requeue(self, new: Engine, req, prefix: Optional[list]):
+        """Resubmit one harvested request on the fresh engine, returning the
+        ``(original_request, continuation_handle)`` pair for the recovery's
+        relay (or None when it resolved inline). ``prefix`` is the
+        accumulated ``prompt + generated`` token list of a mid-flight
+        sequence — submitted as the continuation prompt, it re-prefills
+        exactly like the engine's own preemption path, so greedy decode
+        continues bit-identically; the relay stitches the continuation back
+        into the client's original handle."""
+        prompt = list(prefix) if prefix is not None else list(req.prompt)
+        generated = len(prompt) - len(req.prompt)
+        remaining = req.max_new_tokens - generated
+        # a crash DURING retirement (e.g. a corrupt-pool free) can harvest a
+        # sequence that already finished its work — its tokens ARE the
+        # result, no continuation needed (and a continuation past an eos
+        # would wrongly keep generating)
+        gen = prompt[len(req.prompt):]
+        if req.eos_token_id is not None and req.eos_token_id in gen:
+            cut = len(req.prompt) + gen.index(req.eos_token_id) + 1
+            _finish(req, tokens=prompt[:cut])
+            return None
+        if remaining < 1:
+            _finish(req, tokens=prompt)
+            return None
+        dl = (None if req.deadline is None
+              else max(1e-3, req.deadline - time.monotonic()))
+        try:
+            # _shed_exempt: the old engine already ACCEPTED this work — its
+            # own recovery must not fast-fail it with Overloaded
+            h = new.submit(prompt, max_new_tokens=remaining,
+                           eos_token_id=req.eos_token_id,
+                           temperature=req.temperature,
+                           stream=req.stream_q is not None,
+                           deadline_s=dl, priority=req.priority,
+                           _shed_exempt=True)
+        except Exception as e:
+            _finish(req, error=e if isinstance(e, ServeError)
+                    else ServeError(f"requeue after restart failed: {e!r}"))
+            return None
+        counter_inc("serve_requeued")
+        return (req, h)
+
+    def _fail_all(self, err: BaseException) -> None:
+        with self._lock:
+            eng = self._engine
+        if eng is not None:
+            eng._fail_outstanding(err)
+
+    # -- flight-recorder context ----------------------------------------------
+    def _flight_context(self) -> dict:
+        with self._lock:
+            eng, restarts, broken = self._engine, self._restarts, self._broken
+        return {
+            "restarts": restarts,
+            "max_restarts": self.max_restarts,
+            "watchdog_s": self.watchdog_s,
+            "supervisor_ok": broken is None,
+            "engine": None if eng is None else {
+                "thread_alive": eng._thread.is_alive(),
+                "beat_age_s": round(time.monotonic() - eng._beat, 3),
+                "broken": repr(eng._broken) if eng._broken else None,
+            },
+        }

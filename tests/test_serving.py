@@ -15,30 +15,11 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import profiler
-from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
 from paddle_tpu.serving import (
     Engine, PagePool, RequestCancelled, ServeError,
 )
-
-
-def _tiny_gpt(seed=0):
-    paddle.seed(seed)
-    cfg = GPTConfig(
-        vocab_size=211, hidden_size=32, num_layers=2, num_heads=2,
-        max_position_embeddings=128, hidden_dropout=0.0,
-        attention_dropout=0.0,
-    )
-    m = GPTForPretraining(cfg)
-    m.eval()
-    return m
-
-
-_ENGINE_KW = dict(block_size=8, num_blocks=64, max_batch=8, max_seq_len=128)
-
-
-def _prompts(n, rng, lo=3, hi=24):
-    return [rng.randint(0, 211, (int(rng.randint(lo, hi)),)).tolist()
-            for _ in range(n)]
+from serving_util import ENGINE_KW as _ENGINE_KW
+from serving_util import make_prompts as _prompts, tiny_gpt as _tiny_gpt
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,176 @@
+"""Serving chaos suite — the ``serve.*`` injection points under load.
+
+Drives the round-12 resilience layer the way production fails: engine
+crashes mid-decode under a multi-stream load (supervisor restart must keep
+every greedy stream bit-identical to an uninterrupted run), wedge detection
+inside the watchdog deadline, pool corruption contained to a restart, a
+straggling scheduler missing deadlines, and a 4x-overload storm that the
+engine must SHED (bounded admitted-latency, conserved pool) instead of
+stalling. Marked ``chaos`` like the PR 8 recovery suite: heavier multi-round
+drives, opt-in via PADDLE_TPU_CHAOS=1 on the CPU tier; the single-shot
+tier-1 pins live in tests/test_serving_resilience.py.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import profiler
+from paddle_tpu.fault import inject
+from paddle_tpu.serving import (
+    DeadlineExceeded, Engine, Overloaded, ServeError, ServingSupervisor,
+)
+from serving_util import ENGINE_KW, make_prompts as _prompts, tiny_gpt
+
+pytestmark = pytest.mark.chaos
+
+# a deeper pool than the base config: the storm/restart drives need headroom
+_KW = dict(ENGINE_KW, num_blocks=128)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_gpt()
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    inject.disarm()
+
+
+class TestCrashRecovery:
+    def test_repeated_crashes_under_load_stay_bit_identical(self, model):
+        """Sixteen greedy streams, the engine loop crashes TWICE mid-drive
+        (steps 5 and 12): the supervisor restarts both times and every
+        stream's output is bit-identical to an uninterrupted run — the
+        accumulated-tokens re-prefill continuation changes nothing."""
+        rng = np.random.RandomState(0)
+        prompts = _prompts(16, rng)
+        with Engine(model, **_KW) as eng:
+            baseline = [eng.submit(p, max_new_tokens=10).result(timeout=600)
+                        for p in prompts]
+        inject.arm({"serve.crash": {"at": 5}})
+        with ServingSupervisor(model, watchdog_s=5.0, **_KW) as sup:
+            hs = [sup.submit(p, max_new_tokens=10) for p in prompts]
+            # re-arm mid-drive: a second crash against the restarted engine
+            deadline = time.monotonic() + 60
+            while not inject.fired_counts().get("serve.crash") \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            inject.arm({"serve.crash": {"at": 7}})
+            outs = [h.result(timeout=600) for h in hs]
+            assert sup.restarts == 2
+        assert outs == baseline
+
+    def test_pool_corruption_contained_by_restart(self, model):
+        """serve.pool_corrupt breaks block conservation; the resulting
+        double-free crashes the loop, the supervisor restarts with a FRESH
+        pool, harvested sequences requeue (their dead-pool blocks dropped),
+        and greedy outputs still match the uninterrupted run."""
+        rng = np.random.RandomState(1)
+        prompts = _prompts(6, rng)
+        with Engine(model, **_KW) as eng:
+            baseline = [eng.submit(p, max_new_tokens=8).result(timeout=600)
+                        for p in prompts]
+        c0 = profiler.counters().get("serve_pool_damaged", 0)
+        inject.arm("serve.pool_corrupt:at=3")
+        with ServingSupervisor(model, watchdog_s=5.0, **_KW) as sup:
+            hs = [sup.submit(p, max_new_tokens=8) for p in prompts]
+            outs = [h.result(timeout=600) for h in hs]
+            assert sup.restarts >= 1
+            # the restarted engine's pool conserves
+            st = sup.stats()
+            assert st["pages_used"] == 0
+        assert outs == baseline
+        assert profiler.counters().get("serve_pool_damaged", 0) > c0
+
+
+class TestWedgeDetection:
+    def test_wedge_detected_within_watchdog_deadline(self, model):
+        """From the moment the heartbeat goes stale, the supervisor must
+        declare the wedge within FLAGS_serve_watchdog_s — the in-flight
+        handle fails structurally (never hangs) inside that bound."""
+        rng = np.random.RandomState(2)
+        watchdog_s = 3.0
+        with ServingSupervisor(model, watchdog_s=watchdog_s, **_KW) as sup:
+            sup.generate(rng.randint(0, 211, (5,)).tolist(), max_new_tokens=3)
+            inject.arm("serve.wedge:at=2,ms=120000")
+            t0 = time.monotonic()
+            h = sup.submit(rng.randint(0, 211, (5,)).tolist(),
+                           max_new_tokens=60)
+            with pytest.raises(ServeError, match="wedged"):
+                h.result(timeout=60)
+            elapsed = time.monotonic() - t0
+            assert elapsed < watchdog_s + 1.0, \
+                f"wedge took {elapsed:.2f}s to surface (watchdog {watchdog_s}s)"
+            inject.disarm()
+            # restarted engine serves
+            assert len(sup.generate(rng.randint(0, 211, (4,)).tolist(),
+                                    max_new_tokens=3)) == 7
+
+
+class TestStraggler:
+    def test_slow_step_drives_deadline_misses_not_hangs(self, model):
+        """serve.slow_step makes every scheduler step a straggler; deadlined
+        requests miss and fail structurally while deadline-free traffic
+        still completes — bounded-latency degradation, not a stall."""
+        rng = np.random.RandomState(3)
+        inject.arm("serve.slow_step:from=1,ms=80")
+        with Engine(model, **_KW) as eng:
+            free = eng.submit(rng.randint(0, 211, (5,)).tolist(),
+                              max_new_tokens=6)
+            timed = [eng.submit(p, max_new_tokens=60, deadline_s=0.5)
+                     for p in _prompts(4, rng)]
+            misses = 0
+            for h in timed:
+                try:
+                    h.result(timeout=120)
+                except DeadlineExceeded:
+                    misses += 1
+            assert misses == len(timed)
+            assert len(free.result(timeout=120)) == 11
+            assert eng.stats()["pages_used"] == 0
+
+
+class TestOverloadStorm:
+    def test_shed_keeps_engine_healthy_and_latency_bounded(self, model):
+        """A 4x-style open-loop storm against a shed-armed engine: some
+        requests shed (Overloaded), admitted ones complete with pool
+        conservation intact, and the engine remains healthy and ready
+        afterwards — overload is a first-class, recoverable state."""
+        rng = np.random.RandomState(4)
+        kw = dict(_KW, max_batch=4, max_queue=4, shed=True)
+        shed = completed = missed = 0
+        with Engine(model, **kw) as eng:
+            # unloaded reference latency
+            ref = [eng.submit(p, max_new_tokens=6) for p in _prompts(4, rng)]
+            [h.result(timeout=600) for h in ref]
+            p99_ref = max(h.latency_s for h in ref)
+            handles = []
+            for p in _prompts(120, rng, lo=3, hi=12):
+                try:
+                    handles.append(eng.submit(p, max_new_tokens=6,
+                                              deadline_s=max(2.0, 4 * p99_ref)))
+                except Overloaded as e:
+                    assert e.retry_after_s > 0
+                    shed += 1
+            for h in handles:
+                try:
+                    h.result(timeout=600)
+                    completed += 1
+                except DeadlineExceeded:
+                    missed += 1
+            assert shed > 0, "storm never tripped the shed policy"
+            assert completed > 0
+            lat = sorted(h.latency_s for h in handles if h.latency_s and h.done
+                         and h._req.error is None)
+            # bounded p99 for admitted work: within the deadline we offered
+            assert lat[-1] <= max(2.0, 4 * p99_ref) + 1.0
+            eng._pool.check()
+            assert eng.stats()["pages_used"] == 0
+            assert eng.health()["ok"] and eng.ready()
+            # and it still serves a clean request afterwards
+            out = eng.submit(rng.randint(0, 211, (4,)).tolist(),
+                             max_new_tokens=3).result(timeout=300)
+            assert len(out) == 7

@@ -1,0 +1,446 @@
+"""Serving resilience — deadlines, priorities, load shedding, supervision.
+
+Pins the ISSUE-12 acceptance surface on the tier-1 (in-process, CPU-fast)
+side: per-request deadlines shed expired/doomed work with structured
+``DeadlineExceeded``; eviction is priority-then-youngest; the overload
+policy fast-fails with ``Overloaded`` + a Retry-After hint instead of
+unbounded queueing; ``close(timeout)`` on a wedged scheduler thread fails
+outstanding handles instead of stranding clients (the PR-11 bugfix);
+``ServingSupervisor`` detects an injected crash/wedge within the watchdog
+deadline, restarts the engine, and requeued greedy streams complete
+BIT-IDENTICAL to an uninterrupted run; ``health()``/``ready()`` +
+``close(drain=True)`` support rolling restarts; and the whole layer is
+inert when unconfigured (zero extra threads, the deadline sweep never
+runs). The multi-round storm variants live in tests/test_serving_chaos.py
+(``chaos`` marker).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import profiler
+from paddle_tpu.fault import inject
+from paddle_tpu.serving import (
+    DeadlineExceeded, Engine, Overloaded, ServeError, ServingSupervisor,
+)
+from serving_util import ENGINE_KW, make_prompts as _prompts, tiny_gpt
+
+_KW = dict(ENGINE_KW)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_gpt()
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    inject.disarm()
+
+
+class TestDeadlines:
+    def test_doomed_queued_request_rejected_at_admission(self, model):
+        """A queued request that provably cannot meet its deadline (full
+        token budget at the decode-step EMA) fails EARLY with a structured
+        DeadlineExceeded — before any prefill is paid for it."""
+        rng = np.random.RandomState(0)
+        c0 = profiler.counters().get("serve_deadline_shed", 0)
+        with Engine(model, **_KW) as eng:
+            eng.generate(rng.randint(0, 211, (5,)).tolist(), max_new_tokens=4)
+            # pin the EMA high so the doom verdict is deterministic on any
+            # box: 11 steps at ~1s/step can never fit a 0.5s deadline
+            eng._ema_step_s = 1.0
+            h = eng.submit(rng.randint(0, 211, (5,)).tolist(),
+                           max_new_tokens=10, deadline_s=0.5)
+            with pytest.raises(DeadlineExceeded) as ei:
+                h.result(timeout=60)
+            assert ei.value.request_id == h.request_id
+            assert eng.stats()["pages_used"] == 0
+            # the engine is healthy and still serves deadline-free traffic
+            out = eng.generate(rng.randint(0, 211, (4,)).tolist(),
+                               max_new_tokens=3)
+            assert len(out) == 7
+        assert profiler.counters().get("serve_deadline_shed", 0) > c0
+
+    def test_running_request_expires_mid_decode(self, model):
+        """An admitted request whose real step time blows past the EMA-based
+        admission estimate (injected serve.slow_step straggler) is shed at a
+        step boundary once its deadline passes — bounded latency, blocks
+        freed, the stream fails structurally instead of running to the end
+        of its budget."""
+        rng = np.random.RandomState(1)
+        c0 = profiler.counters().get("serve_deadline_expired", 0)
+        inject.arm("serve.slow_step:from=1,ms=60")
+        with Engine(model, **_KW) as eng:
+            t0 = time.monotonic()
+            h = eng.submit(rng.randint(0, 211, (5,)).tolist(),
+                           max_new_tokens=100, deadline_s=0.4)
+            with pytest.raises(DeadlineExceeded, match="expired"):
+                h.result(timeout=60)
+            # shed near the deadline, nowhere near the 100-step runtime (>6s)
+            assert time.monotonic() - t0 < 4.0
+            assert eng.stats()["pages_used"] == 0
+        assert profiler.counters().get("serve_deadline_expired", 0) > c0
+
+    def test_deadline_validation(self, model):
+        with Engine(model, **_KW) as eng:
+            with pytest.raises(ValueError, match="deadline_s"):
+                eng.submit([1, 2], max_new_tokens=2, deadline_s=0.0)
+
+    def test_deadline_met_request_unaffected(self, model):
+        rng = np.random.RandomState(2)
+        p = rng.randint(0, 211, (7,)).tolist()
+        with Engine(model, **_KW) as eng:
+            plain = eng.generate(p, max_new_tokens=5)
+            timed = eng.submit(p, max_new_tokens=5,
+                               deadline_s=300.0).result(timeout=300)
+        assert timed == plain
+
+
+class TestPriorities:
+    def test_eviction_is_priority_then_youngest(self, model):
+        """Under pool pressure the LOWEST-priority peer is evicted first —
+        the high-priority stream is never preempted (observed through the
+        evict spans' request ids)."""
+        rng = np.random.RandomState(3)
+        with profiler.Profiler():
+            with Engine(model, block_size=8, num_blocks=10, max_batch=4,
+                        max_seq_len=72) as eng:
+                hi = eng.submit(rng.randint(0, 211, (8,)).tolist(),
+                                max_new_tokens=24, priority=5)
+                los = [eng.submit(rng.randint(0, 211, (8,)).tolist(),
+                                  max_new_tokens=24) for _ in range(3)]
+                outs = [h.result(timeout=600) for h in [hi] + los]
+            evicted = {s["attrs"]["request"]
+                       for s in profiler.span_events() if s["name"] == "evict"}
+        assert all(len(o) == 32 for o in outs)  # everyone still completes
+        assert evicted, "pool pressure never forced an eviction"
+        assert hi.request_id not in evicted
+
+    def test_admission_prefers_priority(self, model):
+        """With the engine saturated, a high-priority latecomer is admitted
+        before earlier-queued low-priority requests."""
+        rng = np.random.RandomState(4)
+        with Engine(model, block_size=8, num_blocks=64, max_batch=1,
+                    max_seq_len=128) as eng:
+            hog = eng.submit(rng.randint(0, 211, (4,)).tolist(),
+                             max_new_tokens=60)
+            lo = eng.submit(rng.randint(0, 211, (4,)).tolist(),
+                            max_new_tokens=3)
+            hi = eng.submit(rng.randint(0, 211, (4,)).tolist(),
+                            max_new_tokens=3, priority=9)
+            hi.result(timeout=300)
+            assert not lo.done  # hi jumped the (still-hogged) queue
+            hog.result(timeout=600)
+            lo.result(timeout=600)
+
+
+class TestLoadShedding:
+    def test_overload_fast_fails_with_retry_hint(self, model):
+        rng = np.random.RandomState(5)
+        c0 = profiler.counters().get("serve_shed", 0)
+        with Engine(model, block_size=8, num_blocks=64, max_batch=1,
+                    max_seq_len=128, max_queue=2, shed=True) as eng:
+            hog = eng.submit(rng.randint(0, 211, (4,)).tolist(),
+                             max_new_tokens=80)
+            queued = []
+            shed = None
+            t0 = time.monotonic()
+            for _ in range(50):
+                try:
+                    queued.append(eng.submit(
+                        rng.randint(0, 211, (4,)).tolist(), max_new_tokens=3))
+                except Overloaded as e:
+                    shed = e
+                    break
+            # fast-fail: the shed submit returned immediately, it did not
+            # wait out the hog's 80 decode steps
+            assert time.monotonic() - t0 < 5.0
+            assert shed is not None and shed.retry_after_s > 0.0
+            assert not eng.ready()  # readiness reflects the full queue
+            # the engine is healthy: everything admitted still completes
+            hog.result(timeout=600)
+            for h in queued:
+                h.result(timeout=600)
+            assert eng.stats()["pages_used"] == 0
+            assert eng.ready()
+        assert profiler.counters().get("serve_shed", 0) > c0
+
+    def test_unbounded_queue_without_shed_flag(self, model):
+        """shed=False (the default) keeps PR-11 semantics: the queue grows
+        and everything completes."""
+        rng = np.random.RandomState(6)
+        with Engine(model, block_size=8, num_blocks=64, max_batch=1,
+                    max_seq_len=128, max_queue=2) as eng:
+            hs = [eng.submit(rng.randint(0, 211, (4,)).tolist(),
+                             max_new_tokens=3) for _ in range(8)]
+            for h in hs:
+                h.result(timeout=600)
+
+
+class TestWedgedClose:
+    def test_close_timeout_on_wedged_thread_fails_handles(self, model):
+        """The PR-11 bug: close(timeout) whose join times out returned with
+        pending handles never failed — clients blocked forever in result().
+        Now a timed-out join marks the engine broken and fails every
+        outstanding handle with ServeError."""
+        rng = np.random.RandomState(7)
+        c0 = profiler.counters().get("serve_wedged_close", 0)
+        inject.arm("serve.wedge:at=1,ms=20000")  # wedge on the first step
+        eng = Engine(model, **_KW)
+        h = eng.submit(rng.randint(0, 211, (5,)).tolist(), max_new_tokens=50)
+        deadline = time.monotonic() + 30
+        while not inject.fired_counts().get("serve.wedge") \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert inject.fired_counts().get("serve.wedge") == 1
+        t0 = time.monotonic()
+        eng.close(timeout=0.5)
+        assert time.monotonic() - t0 < 5.0  # close() itself returned promptly
+        with pytest.raises(ServeError):
+            h.result(timeout=5)  # structured failure, NOT a hang
+        assert not eng.health()["ok"]
+        assert profiler.counters().get("serve_wedged_close", 0) > c0
+
+
+class TestSupervisor:
+    def test_crash_mid_decode_restart_bit_identical(self, model):
+        """THE acceptance pin: an injected engine-loop crash mid-decode is
+        detected, the engine restarts over the same config, queued and
+        mid-decode sequences requeue through the accumulated-tokens
+        re-prefill path, and every greedy stream completes bit-identical to
+        an uninterrupted run."""
+        rng = np.random.RandomState(8)
+        prompts = _prompts(6, rng)
+        with Engine(model, **_KW) as eng:
+            baseline = [eng.submit(p, max_new_tokens=8).result(timeout=300)
+                        for p in prompts]
+        c0 = profiler.counters()
+        inject.arm("serve.crash:at=4")  # 4th scheduler step: mid-decode
+        with ServingSupervisor(model, watchdog_s=4.0, **_KW) as sup:
+            hs = [sup.submit(p, max_new_tokens=8) for p in prompts]
+            outs = [h.result(timeout=600) for h in hs]
+            assert sup.restarts == 1
+            assert sup.health()["ok"] and sup.ready()
+        assert outs == baseline
+        c1 = profiler.counters()
+        assert c1.get("serve_crash_detected", 0) > c0.get("serve_crash_detected", 0)
+        assert c1.get("serve_restarts", 0) > c0.get("serve_restarts", 0)
+        assert c1.get("serve_requeued", 0) > c0.get("serve_requeued", 0)
+
+    def test_crash_recovery_keeps_stream_contiguous(self, model):
+        """A streamed request interrupted by the crash keeps yielding: the
+        relay stitches the continuation's tokens onto the original handle,
+        and the full stream equals the uninterrupted generation."""
+        rng = np.random.RandomState(9)
+        p = rng.randint(0, 211, (6,)).tolist()
+        with Engine(model, **_KW) as eng:
+            ref = eng.submit(p, max_new_tokens=10).result(timeout=300)
+        inject.arm("serve.crash:at=5")
+        with ServingSupervisor(model, watchdog_s=4.0, **_KW) as sup:
+            h = sup.submit(p, max_new_tokens=10, stream=True)
+            got = list(h)
+            assert sup.restarts == 1
+        assert p + got == ref
+
+    def test_wedge_fails_inflight_structurally_and_restarts(self, model):
+        """A wedged scheduler thread is detected within the watchdog
+        deadline; in-flight handles fail with a structured ServeError
+        (never hang — the abandoned thread may still own them), and the
+        restarted engine serves new traffic."""
+        rng = np.random.RandomState(10)
+        c0 = profiler.counters().get("serve_wedge_detected", 0)
+        with ServingSupervisor(model, watchdog_s=3.0, **_KW) as sup:
+            # warm first so compile pauses can't imitate a wedge; at=2 puts
+            # the wedge AFTER the admitting step, so the request is
+            # in-flight (a queued request would be requeued, not failed)
+            sup.generate(rng.randint(0, 211, (5,)).tolist(), max_new_tokens=3)
+            inject.arm("serve.wedge:at=2,ms=60000")
+            t0 = time.monotonic()
+            h = sup.submit(rng.randint(0, 211, (5,)).tolist(),
+                           max_new_tokens=50)
+            with pytest.raises(ServeError, match="wedged"):
+                h.result(timeout=30)
+            # detection within the watchdog deadline (+ scheduling slack)
+            assert time.monotonic() - t0 < 3.0 + 2.0
+            inject.disarm()
+            assert sup.restarts == 1
+            out = sup.generate(rng.randint(0, 211, (4,)).tolist(),
+                               max_new_tokens=3)
+            assert len(out) == 7
+        assert profiler.counters().get("serve_wedge_detected", 0) > c0
+
+    def test_requeue_bypasses_shed_policy(self, model):
+        """Recovery must not shed work the engine already accepted: with
+        shed armed and a queue cap smaller than the harvested set, every
+        pre-crash request still completes bit-identically instead of
+        failing Overloaded mid-restart."""
+        rng = np.random.RandomState(18)
+        prompts = _prompts(4, rng)
+        kw = dict(_KW, max_batch=2, max_queue=2, shed=True)
+        with Engine(model, **kw) as eng:
+            baseline = [eng.submit(p, max_new_tokens=10).result(timeout=300)
+                        for p in prompts]
+        with ServingSupervisor(model, watchdog_s=4.0, **kw) as sup:
+            first = [sup.submit(p, max_new_tokens=10) for p in prompts[:2]]
+            deadline = time.monotonic() + 30
+            while sup.stats()["queue_depth"] and time.monotonic() < deadline:
+                time.sleep(0.005)  # both admitted: queue has room again
+            rest = [sup.submit(p, max_new_tokens=10) for p in prompts[2:]]
+            # 2 running + 2 queued accepted; the crash harvests all four
+            # into a fresh engine whose cap (2) is SMALLER than the set
+            inject.arm("serve.crash:at=1")
+            outs = [h.result(timeout=600) for h in first + rest]
+            assert sup.restarts == 1
+        assert outs == baseline
+
+    def test_max_restarts_exhaustion_breaks_supervisor(self, model):
+        rng = np.random.RandomState(11)
+        inject.arm("serve.crash:from=1")  # every step crashes
+        with ServingSupervisor(model, watchdog_s=3.0, max_restarts=1,
+                               **_KW) as sup:
+            h = sup.submit(rng.randint(0, 211, (5,)).tolist(),
+                           max_new_tokens=20)
+            with pytest.raises(ServeError):
+                h.result(timeout=60)
+            deadline = time.monotonic() + 30
+            while sup.health()["supervisor_ok"] \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert not sup.health()["supervisor_ok"]
+            assert not sup.ready()
+            inject.disarm()
+            with pytest.raises(ServeError, match="broken"):
+                sup.submit(rng.randint(0, 211, (4,)).tolist(),
+                           max_new_tokens=2)
+
+
+class TestHealthReadyDrain:
+    def test_health_and_ready_probes(self, model):
+        with Engine(model, **_KW) as eng:
+            h = eng.health()
+            assert h["ok"] and h["thread_alive"] and h["broken"] is None
+            assert h["beat_age_s"] < 30.0
+            assert eng.ready()
+        assert not eng.health()["ok"]
+        assert not eng.ready()
+
+    def test_drain_completes_outstanding_then_stops(self, model):
+        rng = np.random.RandomState(12)
+        prompts = _prompts(5, rng)
+        eng = Engine(model, **_KW)
+        hs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        eng.close(drain=True, timeout=300)
+        outs = [h.result(timeout=5) for h in hs]  # completed, NOT failed
+        for p, out in zip(prompts, outs):
+            assert out[:len(p)] == p and len(out) == len(p) + 6
+        with pytest.raises(ServeError):
+            eng.submit([1, 2], max_new_tokens=2)
+
+    def test_submit_during_drain_rejected(self, model):
+        rng = np.random.RandomState(13)
+        eng = Engine(model, **_KW)
+        hog = eng.submit(rng.randint(0, 211, (4,)).tolist(),
+                         max_new_tokens=40)
+        closer = threading.Thread(
+            target=lambda: eng.close(drain=True, timeout=300), daemon=True)
+        closer.start()
+        deadline = time.monotonic() + 30
+        rejected = None
+        while time.monotonic() < deadline:
+            try:
+                # raced before the drain flag landed: keep probing
+                eng.submit(rng.randint(0, 211, (4,)).tolist(),
+                           max_new_tokens=2).result(timeout=60)
+            except ServeError as e:
+                rejected = e
+                break
+        assert rejected is not None and not eng.ready()
+        hog.result(timeout=600)  # pre-drain work still completed
+        closer.join(timeout=300)
+
+    def test_supervisor_drain_close(self, model):
+        rng = np.random.RandomState(14)
+        sup = ServingSupervisor(model, watchdog_s=5.0, **_KW)
+        hs = [sup.submit(p, max_new_tokens=5) for p in _prompts(3, rng)]
+        sup.close(drain=True, timeout=300)
+        for h in hs:
+            assert len(h.result(timeout=5)) >= 6
+
+
+class TestWatchdogIntegration:
+    def test_supervised_engine_publishes_serving_phase_records(
+            self, model, tmp_path):
+        """A supervised engine's scheduler thread rides the PR 8 progress
+        table: `serve.step` phase records land under the rank's `units`
+        sub-record (watchdog.publish(unit=...)) without clobbering the
+        training step/phase — so cross-rank post-mortems show serving
+        progress next to training progress."""
+        from paddle_tpu.distributed import watchdog
+
+        rng = np.random.RandomState(16)
+        watchdog.configure(rank=0, world_size=1, store=None,
+                           progress_dir=str(tmp_path))
+        try:
+            watchdog.publish(step=41, phase="train", force=True)
+            train_ts = watchdog.local_progress()["ts"]
+            with ServingSupervisor(model, watchdog_s=5.0, **_KW) as sup:
+                sup.generate(rng.randint(0, 211, (5,)).tolist(),
+                             max_new_tokens=8)
+                deadline = time.monotonic() + 30
+                units = {}
+                while not units and time.monotonic() < deadline:
+                    units = watchdog.progress_table().get(0, {}).get("units", {})
+                    time.sleep(0.02)
+            serving = [v for k, v in units.items() if k.startswith("serving_")]
+            assert serving and serving[0]["phase"] == "serve.step"
+            assert serving[0]["step"] >= 0
+            # the training record survived untouched — INCLUDING its
+            # timestamp: a live serving engine must not keep a hung training
+            # loop looking fresh (suspect() ranks stalest-ts on step ties)
+            rec = watchdog.progress_table()[0]
+            assert rec["step"] == 41 and rec["phase"] == "train"
+            assert rec["ts"] == train_ts
+            assert serving[0]["ts"] >= train_ts
+            # the closed engine's unit was pruned AND written through — no
+            # phantom serving unit rides later dumps/heartbeats (the close
+            # was the last publisher, so only a write-through can clear it)
+            stale = [k for k in watchdog.progress_table()[0].get("units", {})
+                     if k.startswith("serving_")]
+            assert not stale, f"stale units persisted: {stale}"
+        finally:
+            watchdog.reset()
+
+
+class TestInertTripwire:
+    def test_unconfigured_path_adds_zero_threads_and_zero_sweeps(
+            self, model, monkeypatch):
+        """The resilience layer must cost NOTHING when unconfigured: no
+        deadline sweep (monkeypatched to explode), no priority scan, no
+        watchdog publish (monkeypatched to explode), and the only thread an
+        engine adds is its own PR-11 scheduler thread — no supervisor
+        monitor, no relays."""
+        import paddle_tpu.serving.engine as E
+        from paddle_tpu.distributed import watchdog
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "resilience machinery ran on the unconfigured path")
+
+        monkeypatch.setattr(E.Engine, "_shed_sweep", boom)
+        monkeypatch.setattr(watchdog, "publish", boom)
+        rng = np.random.RandomState(15)
+        before = {t.ident for t in threading.enumerate()}
+        with Engine(model, **_KW) as eng:
+            hs = [eng.submit(p, max_new_tokens=5) for p in _prompts(4, rng)]
+            [h.result(timeout=300) for h in hs]
+            new = [t for t in threading.enumerate() if t.ident not in before]
+            serve_threads = [t for t in new
+                             if t.name.startswith(("serving", "serve-relay",
+                                                   "paddle-tpu-watchdog"))]
+            assert [t.name for t in serve_threads] == [eng._provider]
+            assert eng._deadline_seen is False and eng._has_prio is False
+            assert eng._supervised is False and eng._watchdog is None
