@@ -801,6 +801,103 @@ def bench_verify_overhead(paddle, jax, np, on_tpu):
     }
 
 
+def bench_stability_overhead(paddle, jax, np, on_tpu):
+    """Stability-sentinel tax on the LeNet train loop (ISSUE-13 acceptance:
+    enabled-path budget <2%, like bench_verify_overhead; the DISABLED path
+    is one attribute probe per flush and one flag probe per fit, pinned ~0
+    by the tier-1 inert tripwire). Enabled arm: a sentinel observes every
+    step's fused signal pack (loss + grad norm + non-finite rate + update
+    ratio, one 4-float readback per step riding the deferred drain) with
+    thresholds set so nothing trips. Two measurements, one verdict — the
+    bench_verify_overhead discipline: (a) interleaved per-step-pair A/B
+    (median of ratios; honest but carries this shared box's scheduler
+    noise), and (b) same-run DIRECT attribution — observe() wall time as a
+    share of enabled-loop step time, immune to drift between arms. The
+    pinned number is (b). Also populates the grad_global_norm / loss_ema
+    fields of the main BENCH line."""
+    from paddle_tpu.fault.sentinel import StabilitySentinel
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+    lossf = paddle.nn.CrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(64, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (64,)))
+    params = [p for p in model.parameters() if not p.stop_gradient]
+    pairs = 40 if on_tpu else 24
+    sent = StabilitySentinel(
+        window=256, warmup=10_000, zmax=1e9, max_skips=0, max_rollbacks=0
+    )
+    step_no = [0]
+    acc = [0.0, 0]  # observe seconds, calls
+
+    def one_step(observe):
+        loss = lossf(model(x), y)
+        loss.backward()
+        if observe:
+            step_no[0] += 1
+            t0 = time.perf_counter()
+            sent.observe(
+                step_no[0], loss=loss,
+                grads=[p.grad for p in params if p.grad is not None],
+                params=params, lr=opt.get_lr(),
+            )
+            acc[0] += time.perf_counter() - t0
+            acc[1] += 1
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    def timed_step(observe):
+        t0 = time.perf_counter()
+        float(one_step(observe).item())
+        return time.perf_counter() - t0
+
+    try:
+        # warm both arms' flush executables (the signal pack is an extra
+        # fused node, so the enabled arm has its own cache signature)
+        one_step(False); one_step(False)
+        one_step(True); one_step(True)
+
+        # (a) interleaved per-step-pair A/B
+        ratios = []
+        for i in range(pairs):
+            if i % 2 == 0:
+                t_on = timed_step(True)
+                t_off = timed_step(False)
+            else:
+                t_off = timed_step(False)
+                t_on = timed_step(True)
+            ratios.append(t_on / t_off)
+        ratios.sort()
+        ab_overhead = ratios[len(ratios) // 2] - 1.0
+
+        # (b) direct attribution: observe() time / enabled-loop step time
+        acc[0] = 0.0
+        acc[1] = 0
+        n_steps = 16
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            float(one_step(True).item())
+        total = time.perf_counter() - t0
+        sent.poll()
+    finally:
+        sent.close()
+    direct = acc[0] / max(total - acc[0], 1e-9)
+    return {
+        "name": (
+            f"stability-sentinel overhead (LeNet eager, {pairs} step pairs "
+            "+ direct attribution)"
+        ),
+        "overhead_pct": round(direct * 100.0, 2),
+        "ab_overhead_pct": round(ab_overhead * 100.0, 2),
+        "observe_us_per_step": round(acc[0] / max(acc[1], 1) * 1e6, 1),
+        "budget_pct": 2.0,
+    }
+
+
 def bench_host_embedding(paddle, jax, np, on_tpu):
     """Embedding-dominated training with a table LARGER than single-chip HBM
     (80M x 64 f32 = 20.5 GB logical, host-memmap'd; v5e HBM is 16 GB) — the
@@ -1043,7 +1140,7 @@ def main():
     extras = []
     for fn in (bench_resnet50_aot, bench_resnet50_int8, bench_lenet_eager,
                bench_profiler_overhead, bench_watchdog_overhead,
-               bench_verify_overhead,
+               bench_verify_overhead, bench_stability_overhead,
                bench_gpt_1p3b, bench_gpt_8k_flash,
                bench_vit_l_aot, bench_yolov3_aot, bench_llama_1b,
                bench_dp8_gpt, bench_serving, bench_host_embedding):
@@ -1103,6 +1200,17 @@ def main():
             None,
         )
 
+    # training-stability telemetry (ISSUE-13): the last judged sentinel
+    # signals (populated by bench_stability_overhead's observed loop; None
+    # when no sentinel ran) plus the skip/rollback counters — every BENCH
+    # line reports whether the run quarantined or rolled back anything
+    try:
+        from paddle_tpu.fault import sentinel as _sentinel
+
+        _stab = _sentinel.last_signals()
+    except Exception:
+        _stab = {}
+
     print(
         json.dumps(
             {
@@ -1113,6 +1221,10 @@ def main():
                 "loss": gpt["loss"],
                 "mfu": gpt["mfu"],
                 "dispatch_gap_ms_per_step": gap,
+                "grad_global_norm": _stab.get("grad_norm"),
+                "loss_ema": _stab.get("loss_ema"),
+                "stability_skips": counters.get("stability_skips", 0),
+                "stability_rollbacks": counters.get("stability_rollbacks", 0),
                 "platform": jax.devices()[0].platform,
                 "wall_s": round(time.time() - t_start, 1),
                 **({"error": gpt["error"]} if gpt.get("error") else {}),

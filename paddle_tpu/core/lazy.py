@@ -82,6 +82,13 @@ __all__ = [
 _state = threading.local()
 _DEFAULT_ENABLED = True  # flipped off per-thread via set_lazy_mode(False)
 
+# Stability-sentinel drain tap (fault/sentinel.py): invoked at the same
+# boundaries as the deferred NaN/Inf drain so the sentinel's per-step fused
+# scalar readback rides the existing deferred-check path instead of adding
+# sync points of its own. None while no sentinel is active — the disabled
+# path is this one attribute probe per flush (tier-1 inert tripwire).
+_stability_tap = None
+
 # Flush when the pending graph reaches this many nodes even without a
 # materialization point (a loop that never prints would otherwise grow the
 # graph unboundedly). Boundaries then land at consistent offsets across
@@ -752,6 +759,9 @@ def sync():
     every flush already behaves like this."""
     flush()
     _drain_deferred()
+    tap = _stability_tap
+    if tap is not None:
+        tap()
     inflight = getattr(_state, "inflight", None)
     if inflight:
         _state.inflight = None
@@ -802,6 +812,9 @@ def flush():
     # deferred work from the PREVIOUS flush surfaces before new work is
     # dispatched — a deferred NaN trip is ≤1 step late, never dropped
     _drain_deferred()
+    tap = _stability_tap
+    if tap is not None:
+        tap()  # non-blocking readiness sweep; never raises, never flushes
     g = getattr(_state, "graph", None)
     if g is None or not g.nodes:
         return

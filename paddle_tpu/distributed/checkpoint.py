@@ -419,7 +419,37 @@ class AutoCheckpoint:
         self.async_save = async_save
         self.save_retries = int(save_retries)
         self._pending = None
+        # rollback-anchor pins (fault/sentinel.py): steps GC must never drop,
+        # whatever keep_last says — the active rollback anchor may be older
+        # than the retention window
+        self._protected: set = set()
         os.makedirs(self.save_dir, exist_ok=True)
+
+    # -- rollback anchor protocol ------------------------------------------
+    def protect(self, step: int) -> None:
+        """Pin ``step``: GC keeps it (and its ``.old`` backup) until
+        :meth:`release`. The stability sentinel pins its active rollback
+        anchor so keep_last can never collect the one checkpoint a rollback
+        needs."""
+        self._protected.add(int(step))
+
+    def release(self, step: int) -> None:
+        self._protected.discard(int(step))
+
+    def protected(self) -> set:
+        return set(self._protected)
+
+    def invalidate(self, step: int) -> None:
+        """Drop ``step``'s checkpoint (primary + backup + manifests) — the
+        sentinel invalidates anchors saved inside a poisoned window after a
+        rollback (a quarantined step is never replayed, so the bad copy
+        would otherwise shadow future rollbacks). Pinned steps refuse."""
+        step = int(step)
+        if step in self._protected:
+            raise ValueError(f"step {step} is a protected rollback anchor")
+        for path in (self._step_path(step), self._step_path(step) + ".old"):
+            shutil.rmtree(path, ignore_errors=True)
+            _remove_manifest(path)
 
     def _meta_path(self):
         return os.path.join(self.save_dir, "latest.json")
@@ -532,6 +562,7 @@ class AutoCheckpoint:
         committed = [s for s in steps if self._step_committed(s)]
         if committed:
             keep.add(committed[-1])
+        keep |= self._protected  # pinned rollback anchors survive any window
         for s in steps:
             if s in keep:
                 continue
@@ -539,16 +570,23 @@ class AutoCheckpoint:
                 shutil.rmtree(path, ignore_errors=True)
                 _remove_manifest(path)
 
-    def resume(self, state_dict: Dict[str, Any]) -> int:
+    def resume(self, state_dict: Dict[str, Any], max_step: Optional[int] = None) -> int:
         """Load the newest VERIFIED checkpoint into state_dict; returns its
         step or -1. Walks candidates newest-first — primary dirs then their
         ``.old`` backups — skipping uncommitted (mid-write crash), corrupt
         (checksum mismatch) and unreadable checkpoints. Does NOT trust
-        latest.json: the pointer can be ahead of the async finalize."""
+        latest.json: the pointer can be ahead of the async finalize.
+
+        ``max_step`` bounds the walk (rollback anchor protocol): checkpoints
+        saved at later steps are skipped outright — a stability rollback
+        must land STRICTLY BEFORE the poisoned step, and an anchor saved
+        inside the detection window may already carry the bad update."""
         if not os.path.isdir(self.save_dir):
             return -1
         fell_back = 0
         for step, _primary, path in self._candidates():
+            if max_step is not None and step > max_step:
+                continue
             man = read_manifest(path)
             if man is not None and not man.get("committed"):
                 fell_back += 1
@@ -637,7 +675,24 @@ class CoordinatedCheckpoint:
             CommitBarrier(self.store, self.world_size, self.rank, prefix="ckpt")
             if self.store is not None else None
         )
+        self._protected: set = set()  # rollback-anchor pins (rank-local)
         os.makedirs(self.save_dir, exist_ok=True)
+
+    # -- rollback anchor protocol (same contract as AutoCheckpoint) --------
+    def protect(self, step: int) -> None:
+        self._protected.add(int(step))
+
+    def release(self, step: int) -> None:
+        self._protected.discard(int(step))
+
+    def invalidate(self, step: int) -> None:
+        """Drop ``step``'s whole step dir (rank 0 only; other ranks no-op so
+        a world-wide sentinel rollback deletes each dir exactly once)."""
+        step = int(step)
+        if step in self._protected:
+            raise ValueError(f"step {step} is a protected rollback anchor")
+        if self.rank == 0:
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)
 
     # -- paths -------------------------------------------------------------
     def _step_dir(self, step: int) -> str:
@@ -834,14 +889,19 @@ class CoordinatedCheckpoint:
         mans = self._rank_manifests(step)
         return all(m is not None and m.get("committed") for m in mans.values())
 
-    def resume(self, state_dict: Dict[str, Any]) -> int:
+    def resume(self, state_dict: Dict[str, Any], max_step: Optional[int] = None) -> int:
         """Load this rank's shard of the newest step EVERY rank committed;
         returns that step or -1. Walks back past uncommitted/partial steps
         (a crashed save); raises on a mixed-step directory (corruption the
         protocol can't produce). With a store bound, the world additionally
-        agrees on the resolved step before anyone loads."""
+        agrees on the resolved step before anyone loads. ``max_step`` bounds
+        the walk (stability-rollback anchor protocol — see
+        ``AutoCheckpoint.resume``); every rank must pass the same bound or
+        the agreement check rejects the resume."""
         fell_back = 0
         for step in self._steps_on_disk():
+            if max_step is not None and step > max_step:
+                continue
             self.check_manifest_agreement(step)
             if not self._step_fully_committed(step):
                 fell_back += 1
@@ -922,6 +982,7 @@ class CoordinatedCheckpoint:
         committed = [s for s in steps if self._step_fully_committed(s)]
         if committed:
             keep.add(committed[-1])
+        keep |= self._protected  # pinned rollback anchors survive any window
         for s in steps:
             if s in keep:
                 continue
@@ -951,15 +1012,19 @@ def engine_state_dict(engine) -> Dict[str, Any]:
     return state
 
 
-def engine_load_state_dict(engine, path) -> None:
-    """Restore params AND optimizer accumulators of a HybridParallelEngine
-    from a checkpoint written via ``engine_state_dict``."""
-    state = engine_state_dict(engine)
-    load_state_dict(state, path)
+def engine_apply_state(engine, state: Dict[str, Any]) -> None:
+    """Push a RESTORED ``engine_state_dict`` tree back into the engine: the
+    param entries restored in place (they wrap the live Tensors), but the
+    accumulator entries are wrapper copies — copy them into the optimizer's
+    accumulators, restore the step count, and invalidate the engine-resident
+    ZeRO sharded state so the next step repacks from the restored
+    accumulators (the PR 3 failed-step recovery path). Shared by
+    ``engine_load_state_dict`` and the stability sentinel's rollback."""
     opt = engine.optimizer
     step_t = state.get("opt_step")
     if step_t is not None:
-        opt._step_count = int(np.asarray(step_t._data))
+        # cold path (checkpoint restore): the step counter must materialize
+        opt._step_count = int(np.asarray(_concrete(step_t._data)))  # lint: ok(host-sync)
     for i, p in enumerate(engine.params):
         accum = opt._accumulators.get(id(p))
         if accum is None:
@@ -973,8 +1038,16 @@ def engine_load_state_dict(engine, path) -> None:
         inval()  # next step repacks the sharded state from restored accums
 
 
+def engine_load_state_dict(engine, path) -> None:
+    """Restore params AND optimizer accumulators of a HybridParallelEngine
+    from a checkpoint written via ``engine_state_dict``."""
+    state = engine_state_dict(engine)
+    load_state_dict(state, path)
+    engine_apply_state(engine, state)
+
+
 __all__ = [
     "save_state_dict", "load_state_dict", "AutoCheckpoint", "CheckpointError",
     "CoordinatedCheckpoint", "read_manifest", "engine_state_dict",
-    "engine_load_state_dict",
+    "engine_apply_state", "engine_load_state_dict",
 ]

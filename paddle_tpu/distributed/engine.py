@@ -83,6 +83,19 @@ class HybridParallelEngine:
         # bucket-flat optimizer state, physically sharded over the dp axis.
         self._wus = None
         self._dp_state = None
+        # stability sentinel (fault/sentinel.py); None keeps the zero-cost
+        # path — one attribute check per train_step
+        self._sentinel = None
+
+    def attach_sentinel(self, sentinel) -> None:
+        """Hook a :class:`~paddle_tpu.fault.sentinel.StabilitySentinel` into
+        the step path: ``train_step`` consults the ``loss.spike``/
+        ``grad.spike`` chaos points at the step boundary and feeds the step's
+        loss into the sentinel as a COMMITTED observation — the donated fused
+        step has already applied the update by the time the loss is
+        readable, so a trip escalates to rollback (never skip), restoring
+        engine-resident ZeRO shards through ``engine_apply_state``."""
+        self._sentinel = sentinel
 
     # -- placement ---------------------------------------------------------
     def place(self):
@@ -341,6 +354,12 @@ class HybridParallelEngine:
     def _train_step_impl(self, sp, *batch):
         param_arrays, opt_state, batch_arrays, lr, key = self._prepare(*batch)
         sp.set(wus=self._wus is not None, params=len(self.params))
+        if self._sentinel is not None:
+            # chaos spikes are applied to the batch device-side (a poisoned
+            # batch is exactly what the sentinel exists to survive)
+            batch_arrays = self._sentinel.maybe_spike(
+                batch_arrays, step=self.optimizer._step_count + 1
+            )
         try:
             loss, new_params, new_state = self._jit(
                 param_arrays, opt_state, batch_arrays, lr, key
@@ -372,10 +391,23 @@ class HybridParallelEngine:
             profiler.counter_inc("wus_enabled", 1 - profiler.counters().get("wus_enabled", 0))
             for k, v in self._wus.step_counters().items():
                 profiler.counter_inc(k, v)
+            self._observe_stability(loss)
             return Tensor(loss)
         self.optimizer._functional_restore(self.params, new_state)
         self.optimizer._step_count += 1
+        self._observe_stability(loss)
         return Tensor(loss)
+
+    def _observe_stability(self, loss) -> None:
+        """Feed the committed step's loss to the attached sentinel (verdicts
+        surface via ``sentinel.take_verdict()`` after ``train_step``
+        returns). The loss is handed over as the in-flight device array —
+        the sentinel defers the readback one step, so no host sync lands on
+        the dispatch path."""
+        if self._sentinel is not None:
+            self._sentinel.observe(
+                self.optimizer._step_count, loss=loss, committed=True, stash=True
+            )
 
     def sync_optimizer_state(self):
         """Unpack the engine-resident ZeRO-1 sharded optimizer state into the

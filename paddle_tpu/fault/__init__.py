@@ -17,6 +17,12 @@ fault layer that goes further:
   handlers that drain the pending lazy graph, force a final synchronous
   checkpoint and exit with :data:`RESUMABLE_EXIT_CODE`; the launcher and
   elastic supervisor treat that code as a clean restart.
+* :mod:`~paddle_tpu.fault.sentinel` — ``StabilitySentinel``: statistical
+  anomaly detection over per-step training signals (loss, global grad norm,
+  update/param ratio, non-finite rate) with a skip → rollback → halt policy
+  ladder, batch quarantine, and sample-exact auto-rollback to a pinned
+  anchor checkpoint. Constructing a sentinel is the only thing that arms
+  the per-flush drain tap; unconfigured training pays one attribute probe.
 """
 from __future__ import annotations
 
@@ -25,8 +31,12 @@ from . import retry  # noqa: F401
 from .inject import InjectedFault  # noqa: F401
 from .preemption import PreemptionGuard, RESUMABLE_EXIT_CODE  # noqa: F401
 from .retry import retry_call, retrying  # noqa: F401
+from .sentinel import (  # noqa: F401
+    QuarantineLog, StabilityError, StabilitySentinel, StabilityVerdict,
+)
 
 __all__ = [
     "inject", "retry", "InjectedFault", "PreemptionGuard",
     "RESUMABLE_EXIT_CODE", "retry_call", "retrying",
+    "QuarantineLog", "StabilityError", "StabilitySentinel", "StabilityVerdict",
 ]

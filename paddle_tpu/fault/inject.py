@@ -42,6 +42,11 @@ consulted by the serving engine's scheduler thread at every step boundary —
 they drive the ServingSupervisor recovery suite (tests/test_serving_chaos.py).
 ``serve.wedge`` wedges the scheduler thread forever by default (the
 supervisor abandons it); ``ms=N`` bounds the wedge for detection-only tests.
+Training-stability chaos points (``loss.spike`` / ``grad.spike``) are
+consulted at the step boundary via :func:`spike` — they scale the step's
+loss/gradients by ``scale=`` (or poison them non-finite with
+``nonfinite=1``) and drive the StabilitySentinel skip/rollback suites
+(tests/test_stability_sentinel.py, tests/test_stability_chaos.py).
 """
 from __future__ import annotations
 
@@ -65,6 +70,9 @@ POINTS: Dict[str, str] = {
     "ckpt.serialize": "coordinated save — crash during state serialization",
     "ckpt.ack": "coordinated save — crash after durable write, before the ack",
     "ckpt.commit": "coordinated save — crash between full acks and the commit record",
+    # -- training-stability chaos points (fault/sentinel.py step boundary) ----
+    "loss.spike": "train step boundary — scale the step's loss (scale=/nonfinite= payload)",
+    "grad.spike": "train step boundary — scale the step's gradients (scale=/nonfinite= payload)",
     # -- serving chaos points (serving/engine.py scheduler step boundary) -----
     "serve.crash": "serving engine loop — raise inside the scheduler step",
     "serve.wedge": "serving engine loop — wedge the scheduler thread (ms=N bounds it)",
@@ -261,6 +269,25 @@ def chaos_drop(rank: Optional[int] = None, step: Optional[int] = None) -> None:
         _hang("collective.drop")
 
 
+def spike(point: str, step: Optional[int] = None,
+          rank: Optional[int] = None) -> Optional[float]:
+    """Consult a ``loss.spike``/``grad.spike`` point at the step boundary
+    (the stability-sentinel chaos payloads). Returns the multiplier to apply
+    to the step's loss/gradients — ``scale=`` (default 1000), or
+    ``float('inf')`` with ``nonfinite=1`` (drives the deferred-guard window:
+    a non-finite update that commits before the trip surfaces) — or None
+    when the point doesn't fire. ``at=``/``step=``/``rank=`` select the
+    firing call like every other point."""
+    if point not in ("loss.spike", "grad.spike"):
+        raise KeyError(f"not a spike point: {point!r}")
+    if not _armed or not should_fire(point, step=step, rank=rank):
+        return None
+    cfg = point_cfg(point)
+    if cfg.get("nonfinite"):
+        return float("inf")
+    return float(cfg.get("scale", 1000))
+
+
 def exercised() -> set:
     """Point names that have fired at least once in this process."""
     return set(_exercised)
@@ -314,5 +341,5 @@ _arm_from_env()
 __all__ = [
     "POINTS", "InjectedFault", "arm", "disarm", "armed", "should_fire",
     "check", "exercised", "fired_counts", "poison_first_nan", "point_cfg",
-    "chaos", "chaos_drop",
+    "chaos", "chaos_drop", "spike",
 ]

@@ -112,6 +112,26 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_serve_max_queue": 0,
     "FLAGS_serve_shed": False,
     "FLAGS_serve_watchdog_s": 10.0,
+    # Training stability sentinel (fault/sentinel.py): statistical anomaly
+    # detection over per-step signals (loss, global grad norm, update/param
+    # ratio, non-finite rate) with a skip -> rollback -> halt policy ladder,
+    # batch quarantine and sample-exact auto-rollback. FLAGS_stability_enable
+    # turns the hapi.Model.fit wiring on (one flag probe per fit call when
+    # off); loops can also pass a configured StabilitySentinel explicitly.
+    # window/warmup/zmax parameterize the robust (median/MAD) statistics;
+    # max_skips/max_rollbacks/cooldown shape the escalation ladder;
+    # anchor_interval + ckpt_dir configure the rollback anchor checkpoint;
+    # quarantine_dir (when set) persists the quarantine log as JSONL.
+    "FLAGS_stability_enable": False,
+    "FLAGS_stability_window": 64,
+    "FLAGS_stability_warmup": 8,
+    "FLAGS_stability_zmax": 8.0,
+    "FLAGS_stability_max_skips": 2,
+    "FLAGS_stability_max_rollbacks": 2,
+    "FLAGS_stability_cooldown": 16,
+    "FLAGS_stability_anchor_interval": 25,
+    "FLAGS_stability_ckpt_dir": "",
+    "FLAGS_stability_quarantine_dir": "",
     # JAX persistent compilation cache (warm executable starts across
     # processes). Dir defaults to ~/.cache/paddle_tpu/xla when unset.
     "FLAGS_xla_persistent_cache": True,

@@ -109,7 +109,13 @@ class Model:
         accumulate_grad_batches=1,
         num_iters=None,
         device_prefetch=0,
+        stability=None,
     ):
+        # Training stability sentinel (fault/sentinel.py): `stability` is a
+        # configured StabilitySentinel, True (build one from the
+        # FLAGS_stability_* registry), or None — in which case the flag
+        # registry decides. Disabled cost: this one probe per fit() call.
+        sentinel = self._resolve_sentinel(stability)
         # device_prefetch=N stages the next N batches ON DEVICE while the
         # current step runs (the PR 6 DevicePrefetcher double-buffering,
         # plumbed through to the fit loop — ROADMAP item 2 leftover). 0 = off.
@@ -136,6 +142,23 @@ class Model:
             log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
             verbose=verbose, metrics=self._metrics_name(),
         )
+        if sentinel is not None:
+            if device_prefetch:
+                # the sentinel loop manages the loader position directly for
+                # rollback replay and does not wrap a DevicePrefetcher; warn
+                # rather than silently dropping the requested double-buffer
+                import warnings
+
+                warnings.warn(
+                    "Model.fit: device_prefetch is not supported together "
+                    "with the stability sentinel yet; training proceeds "
+                    "without device-side input double-buffering"
+                )
+            return self._fit_sentinel_loop(
+                sentinel, train_loader, eval_loader, cbks, epochs=epochs,
+                batch_size=batch_size, eval_freq=eval_freq,
+                save_dir=save_dir, save_freq=save_freq, num_iters=num_iters,
+            )
         cbks.on_begin("train")
         steps_done = 0
         for epoch in range(epochs):
@@ -177,6 +200,171 @@ class Model:
         cbks.on_end("train", logs)
         if save_dir:
             self.save(os.path.join(save_dir, "final"))
+
+    # -- training stability sentinel wiring --------------------------------
+    def _resolve_sentinel(self, stability):
+        from ..framework import flags as _flags
+
+        if stability is None:
+            if not _flags.flag("FLAGS_stability_enable", False):
+                return None
+        elif not stability:
+            return None  # explicit opt-out (False/0) overrides the flag
+        from ..fault.sentinel import StabilitySentinel
+
+        if isinstance(stability, StabilitySentinel):
+            return stability
+        s = StabilitySentinel.from_flags()
+        s._auto = True  # fit owns it: closed (tap disarmed) when fit returns
+        return s
+
+    def _fit_sentinel_loop(self, sentinel, train_loader, eval_loader, cbks,
+                           epochs, batch_size, eval_freq, save_dir, save_freq,
+                           num_iters):
+        """The fit loop with the stability sentinel in the step path: per
+        batch — chaos-spike consult, backward, device-side signal pack,
+        verdict handling (skip discards the update and quarantines the
+        batch; rollback restores model+optimizer+LR+RNG+loader from the
+        anchor and replays with quarantined batches skipped at the index
+        level; halt raises StabilityError after a flight post-mortem) — plus
+        periodic anchor checkpoints keyed by global step."""
+        from ..core.random import program_rng
+
+        opt = self._optimizer
+        state = {
+            "model": self.network, "optimizer": opt,
+            "loader": train_loader, "rng": program_rng,
+        }
+        params = [p for p in self.network.parameters() if not p.stop_gradient]
+        try:
+            self._fit_sentinel_body(
+                sentinel, train_loader, eval_loader, cbks, epochs, batch_size,
+                eval_freq, save_dir, save_freq, num_iters, state, params,
+            )
+        finally:
+            if getattr(sentinel, "_auto", False):
+                sentinel.close()
+
+    def _fit_sentinel_body(self, sentinel, train_loader, eval_loader, cbks,
+                           epochs, batch_size, eval_freq, save_dir, save_freq,
+                           num_iters, state, params):
+        from ..fault import inject as _inject
+
+        cbks.on_begin("train")
+        global_step = 0  # steps that reached a verdict (trained or skipped)
+        epoch0 = train_loader._epoch
+        logs = {}
+        cur_epoch = None
+        done = False
+        while not done and train_loader._epoch - epoch0 < epochs:
+            if cur_epoch != train_loader._epoch:
+                cur_epoch = train_loader._epoch
+                for m in self._metrics:
+                    m.reset()
+                cbks.on_epoch_begin(cur_epoch - epoch0)
+            it = train_loader._stateful_iter()
+            restarted = False
+            while True:
+                pos = (train_loader._epoch, train_loader._batch_idx)
+                if sentinel.is_quarantined(pos=pos):
+                    if not it.skip_batch():
+                        break  # quarantined batch was the epoch's last
+                    global_step += 1
+                    continue
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                cbks.on_batch_begin("train", pos[1], logs)
+                ins, labs = self._split_batch(batch)
+                result, verdict = self._sentinel_train_batch(
+                    sentinel, global_step, pos, ins, labs, params,
+                    train_loader, _inject,
+                )
+                if verdict is not None and verdict.action == "rollback":
+                    anchor_step = sentinel.rollback(verdict, state)
+                    global_step = anchor_step + 1
+                    restarted = True
+                    break
+                if verdict is not None and verdict.action == "halt":
+                    sentinel.halt(verdict)
+                if result is not None:
+                    logs = self._make_logs(result)
+                    logs["step"] = pos[1]
+                    logs["batch_size"] = batch_size
+                    cbks.on_batch_end("train", pos[1], logs)
+                global_step += 1
+                sentinel.maybe_anchor(global_step - 1, state)
+                if num_iters is not None and global_step >= num_iters:
+                    done = True
+                    break
+            if restarted:
+                cur_epoch = None  # re-enter at the restored loader position
+                continue
+            if done or self.stop_training:
+                break
+            # epoch completed (the _StatefulIter rolled the loader forward)
+            ep = cur_epoch - epoch0
+            if eval_loader is not None and (ep + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(ep, logs)
+            if save_dir and (ep + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(ep)))
+        cbks.on_end("train", logs)
+        if save_dir:
+            self.save(os.path.join(save_dir, "final"))
+
+    def _sentinel_train_batch(self, sentinel, step, pos, inputs, labels,
+                              params, loader, _inject):
+        """One sentinel-guarded train step. Returns ``(result, verdict)`` —
+        ``result`` is None when the update was withheld (skip/rollback/halt
+        verdicts; the optimizer never ran)."""
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        outputs = self.network(
+            *[Tensor(i) if not isinstance(i, Tensor) else i for i in inputs]
+        )
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        loss = self._loss(
+            *(outs + [l if isinstance(l, Tensor) else Tensor(l) for l in labels])
+        )
+        loss_t = loss if isinstance(loss, Tensor) else loss[0]
+        if _inject.armed():
+            s = _inject.spike("loss.spike", step=step)
+            if s is not None:
+                loss_t = loss_t * s
+        loss_t.backward()
+        if _inject.armed():
+            s = _inject.spike("grad.spike", step=step)
+            if s is not None:
+                for p in params:
+                    if p.grad is not None:
+                        p.grad._set_data((p.grad * s)._data)
+        verdict = sentinel.observe(
+            step,
+            loss=loss_t,
+            grads=[p.grad for p in params if p.grad is not None],
+            params=params,
+            lr=self._optimizer.get_lr(),
+            pos=pos,
+            indices_fn=lambda e=pos[0], b=pos[1]: loader.batch_indices(e, b),
+        )
+        if verdict is not None:
+            # any verdict withholds this step's update: a same-step skip by
+            # policy; a late rollback/halt because the half-finished step is
+            # discarded with the poisoned timeline anyway
+            self._optimizer.clear_grad()
+            return None, verdict
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(outs[0], *labels))
+            metrics.append(m.accumulate())
+        result = ([float(loss_t.item())], metrics) if metrics else [float(loss_t.item())]
+        return result, None
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None, num_samples=None):
         loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(eval_data, batch_size=batch_size)

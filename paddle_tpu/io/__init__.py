@@ -516,6 +516,18 @@ class _DataLoaderIter:
             return list(batch)
         return batch
 
+    def skip_next(self):
+        """Advance one batch WITHOUT loading its samples — the stability
+        sentinel's quarantine skip stays at the INDEX level on the
+        synchronous path (the dataset is never read). Worker paths have
+        already prefetched the batch, so it is fetched and discarded (order
+        preserved either way). Raises StopIteration at epoch end like
+        ``__next__``."""
+        if self.num_workers == 0:
+            next(self.batch_sampler_iter)
+            return
+        next(self)
+
 
 class _IterableIter:
     def __init__(self, loader):
@@ -804,6 +816,30 @@ class _StatefulIter:
         self.loader._batch_idx = self._start_idx + self._produced
         return batch
 
+    def skip_batch(self) -> bool:
+        """Advance past the next batch without training on it (quarantine
+        skip) — index-level when the inner iterator supports it. Position
+        bookkeeping advances exactly like a consumed batch, so sample-exact
+        resume state stays aligned with the uninterrupted order. Returns
+        False when the epoch is already exhausted (rolling the loader to the
+        next epoch like ``__next__`` does)."""
+        skip = getattr(self.inner, "skip_next", None)
+        try:
+            if skip is not None:
+                skip()
+            else:
+                next(self.inner)
+        except StopIteration:
+            self.loader._epoch += 1
+            self.loader._batch_idx = 0
+            return False
+        self._produced += 1
+        self.loader._batch_idx = self._start_idx + self._produced
+        from .. import profiler
+
+        profiler.counter_inc("io_quarantine_skips")
+        return True
+
     def state_at(self, consumed: int) -> dict:
         """Loader position as of ``consumed`` batches handed out by THIS
         epoch iterator — what DevicePrefetcher reports, because its read-
@@ -930,6 +966,28 @@ class DataLoader:
     # checkpoint-tree participation: distributed/checkpoint.py restores
     # state_dict-bearing objects through set_state_dict
     set_state_dict = load_state_dict
+
+    def batch_indices(self, epoch: int, batch_idx: int):
+        """Sample indices of batch ``batch_idx`` in ``epoch`` — the
+        stability sentinel's quarantine log names the exact samples of a
+        condemned batch with this. Reconstructable only when the batch order
+        is a pure function of ``(seed, epoch)`` (seeded shuffle, or no
+        shuffle); returns None otherwise (the log then records the position
+        only). O(batch_idx) — called on quarantine events, not per step."""
+        if self.batch_sampler is None:
+            return None
+        sampler = getattr(self.batch_sampler, "sampler", None)
+        if self.shuffle and not isinstance(sampler, _SeededRandomSampler):
+            return None
+        saved = self._epoch
+        self._epoch = int(epoch)  # _SeededRandomSampler reads via epoch_fn
+        try:
+            for i, idxs in enumerate(self.batch_sampler):
+                if i == int(batch_idx):
+                    return [int(x) for x in idxs]
+        finally:
+            self._epoch = saved
+        return None
 
     def _index_iter(self):
         """Index-batch stream for this epoch, with the resume fast-forward

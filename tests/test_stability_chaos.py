@@ -1,0 +1,225 @@
+"""Stability-sentinel chaos suite: REPEATED loss/grad spikes under 2-proc
+training, recovered by coordinated sentinel rollback with bit-exact parity.
+
+Each worker runs a deterministic per-rank train loop with a
+``StabilitySentinel`` anchored on a ``CoordinatedCheckpoint``. The armed
+``grad.spike`` / ``loss.spike`` points fire on BOTH ranks at two different
+steps (two separate incidents — the cooldown resets the ladder between
+them); detection is deferred (≤1 step late, ``FLAGS_lazy_async``), so each
+incident escalates to rollback. Both ranks resolve the same anchor through
+the store-mediated resume agreement (``resume(max_step=...)``), replay with
+the quarantined steps skipped, and the final per-step records — loss and
+weights, hex-exact — must equal a reference world that excluded those
+batches up front.
+
+Workers are fresh interpreters over a FileStore (the ``spawn`` substrate),
+so the suite carries the ``chaos`` marker: auto-skipped on the CPU tier,
+opt in with ``PADDLE_TPU_CHAOS=1``.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+pytestmark = [pytest.mark.faults, pytest.mark.chaos]
+
+WORLD = 2
+TOTAL_STEPS = 9
+SPIKE_SPEC = "grad.spike:step=4,scale=1000000;loss.spike:step=7,scale=1000000"
+QUARANTINED = (4, 7)
+
+_WORKER = r"""
+import json, os, sys
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+import paddle_tpu
+from paddle_tpu.distributed import watchdog
+from paddle_tpu.distributed.checkpoint import CoordinatedCheckpoint
+from paddle_tpu.distributed.coord import wait_for
+from paddle_tpu.fault import inject
+from paddle_tpu.fault.sentinel import StabilitySentinel
+from paddle_tpu.core import lazy
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+run_dir = os.environ["CHAOS_RUN_DIR"]
+total_steps = int(os.environ["CHAOS_TOTAL_STEPS"])
+pre_q = [int(s) for s in os.environ.get("CHAOS_PRE_Q", "").split(",") if s]
+
+watchdog.configure()  # rank/world/store from the launcher env
+store = watchdog._cfg["store"]
+assert store is not None, "stability chaos worker needs PADDLE_TPU_STORE_DIR"
+
+
+def data_for(step):
+    rng = np.random.RandomState(7000 + 100 * rank + step)
+    return rng.randn(8, 4).astype(np.float32), rng.randn(8, 1).astype(np.float32)
+
+
+w = paddle_tpu.to_tensor(np.full((4, 1), 0.5, np.float32))
+w.stop_gradient = False
+opt = paddle_tpu.optimizer.Adam(learning_rate=0.05, parameters=[w])
+state = {"w": w, "opt": opt}
+
+cc = CoordinatedCheckpoint(
+    os.path.join(run_dir, "ckpt"), world_size=world, rank=rank, store=store,
+    interval_steps=1, commit_timeout_s=30.0,
+)
+sent = StabilitySentinel(window=32, warmup=3, zmax=50, max_skips=2,
+                         max_rollbacks=2, cooldown=2, anchor=cc)
+for s in pre_q:
+    sent.quarantine.add(-1, pos=(0, s), action="skip")
+
+records = {}
+step = 0
+rollbacks = []
+while step < total_steps:
+    if sent.is_quarantined(pos=(0, step)):
+        step += 1
+        continue
+    x, y = data_for(step)
+    xt, yt = paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(y)
+    loss = ((paddle_tpu.matmul(xt, w) - yt) ** 2).mean()
+    s = inject.spike("loss.spike", step=step)
+    if s is not None:
+        loss = loss * s
+    loss.backward()
+    s = inject.spike("grad.spike", step=step)
+    if s is not None:
+        w.grad._set_data((w.grad * s)._data)
+    v = sent.observe(step, loss=loss, grads=[w.grad], params=[w],
+                     lr=opt.get_lr(), pos=(0, step))
+    if v is not None:
+        opt.clear_grad()
+        if v.action == "skip" and v.step == step:
+            step += 1
+            continue
+        if v.action == "rollback":
+            # every rank reaches the same verdict on the same step (the
+            # spike fires world-wide); the coordinated resume agreement
+            # inside cc.resume pins them to one anchor
+            a = sent.rollback(v, state)
+            rollbacks.append([v.step, a])
+            step = a + 1
+            continue
+        sent.halt(v)
+    opt.step()
+    opt.clear_grad()
+    records[step] = {
+        "loss_hex": float(loss.item()).hex(),
+        "w_hex": [float(x_) for x_ in np.asarray(lazy.concrete(w._data)).ravel()],
+    }
+    # lockstep barrier so both ranks observe/rollback in the same window
+    bar = f"stab/bar/{step}/{len(rollbacks)}"
+    store.add(bar, 1)
+    wait_for(lambda: int(store.get(bar) or 0) >= world,
+             f"stability barrier step {step}", 60.0, interval_s=0.01)
+    sent.maybe_anchor(step, state)
+    step += 1
+
+sent.poll()
+sent.close()
+# quarantined steps' stale (poisoned) records are not part of the final
+# timeline — the replay skipped them
+for e in sent.quarantine.entries():
+    records.pop(e["step"], None)
+out = {
+    "records": {str(k): v for k, v in sorted(records.items())},
+    "rollbacks": rollbacks,
+    "quarantined": sorted({e["step"] for e in sent.quarantine.entries()}),
+}
+with open(os.path.join(run_dir, f"out_rank{rank}.json"), "w") as f:
+    json.dump(out, f)
+sys.exit(0)
+"""
+
+
+def _launch_world(run_dir, inject_spec=None, pre_q=()):
+    script = run_dir / "worker.py"
+    script.write_text(_WORKER)
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        repo_root = str(Path(__file__).resolve().parent.parent)
+        env.update({
+            "PYTHONPATH": repo_root + (
+                os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+            ),
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(WORLD),
+            "PADDLE_TPU_STORE_DIR": str(run_dir / "store"),
+            "PADDLE_TPU_PROGRESS_DIR": str(run_dir / "progress"),
+            "PADDLE_TPU_FLIGHT_DIR": str(run_dir / "flight"),
+            "CHAOS_RUN_DIR": str(run_dir),
+            "CHAOS_TOTAL_STEPS": str(TOTAL_STEPS),
+            "CHAOS_PRE_Q": ",".join(str(s) for s in pre_q),
+        })
+        env.pop("PADDLE_FAULT_INJECT", None)
+        if inject_spec:
+            env["PADDLE_FAULT_INJECT"] = inject_spec
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        ))
+    return procs
+
+
+def _wait_world(procs, deadline_s=240.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        codes = [p.poll() for p in procs]
+        if all(c is not None for c in codes):
+            return codes
+        time.sleep(0.2)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    raise AssertionError(
+        "stability chaos world did not finish; logs="
+        f"{[p.stdout.read().decode()[-800:] for p in procs]}"
+    )
+
+
+def _read_out(run_dir, rank):
+    return json.loads((run_dir / f"out_rank{rank}.json").read_text())
+
+
+def test_repeated_spikes_recovered_bit_exact_2proc(tmp_path):
+    # reference world: the two condemned batches excluded up front
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    procs = _launch_world(ref_dir, pre_q=QUARANTINED)
+    codes = _wait_world(procs)
+    assert codes == [0] * WORLD, [p.stdout.read().decode()[-800:] for p in procs]
+
+    # chaos world: both spikes fire on both ranks, detection deferred
+    run_dir = tmp_path / "chaos"
+    run_dir.mkdir()
+    procs = _launch_world(run_dir, inject_spec=SPIKE_SPEC)
+    codes = _wait_world(procs)
+    assert codes == [0] * WORLD, [p.stdout.read().decode()[-800:] for p in procs]
+
+    for rank in range(WORLD):
+        ref = _read_out(ref_dir, rank)
+        got = _read_out(run_dir, rank)
+        # two separate incidents, each rolled back to an anchor strictly
+        # before the poisoned step
+        assert len(got["rollbacks"]) == 2
+        for bad, anchor in got["rollbacks"]:
+            assert anchor < bad
+        assert got["quarantined"] == sorted(QUARANTINED)
+        assert not ref["rollbacks"]
+        # bit-exact parity: every surviving step's loss and weights match
+        assert set(got["records"]) == set(ref["records"])
+        for k in ref["records"]:
+            assert got["records"][k] == ref["records"][k], (
+                f"rank {rank} step {k}: post-recovery divergence"
+            )

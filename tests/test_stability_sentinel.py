@@ -1,0 +1,655 @@
+"""Training stability sentinel (ISSUE 13) — anomaly detection, batch
+quarantine, and sample-exact auto-rollback.
+
+Pins the acceptance criteria on CPU:
+* an injected grad/loss spike at step k (eager/sync AND lazy-async, and —
+  in test_stability_engine — through the engine with and without
+  ``FLAGS_shard_weight_update``) is skipped or rolled back per the policy
+  ladder, with final weights, optimizer moments, LR-scheduler state and
+  sample order BIT-IDENTICAL to an uninterrupted run trained on the same
+  data with the quarantined batch excluded;
+* the quarantine log names the skipped sample indices + signal values;
+* the PR 6 caveat is CLOSED: a non-finite trip surfacing ≤1 step late under
+  ``FLAGS_lazy_async`` (the poisoned update has committed — asserted) is
+  fully recovered by sentinel rollback instead of being only a documented
+  window;
+* ``AutoCheckpoint`` anchor pinning: ``protect``/``release`` keep the
+  active rollback anchor out of GC's reach even with keep_last=1 and an
+  anchor older than the retention window;
+* the halt rung dumps a flight post-mortem naming the tripping signal;
+* tier-1 inert tripwire: an unconfigured sentinel costs nothing — the
+  detector is never called, no threads appear, no per-step host syncs, and
+  the lazy drain tap stays None.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import profiler
+from paddle_tpu.core import lazy
+from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+from paddle_tpu.fault import inject
+from paddle_tpu.fault import sentinel as sentinel_mod
+from paddle_tpu.fault.sentinel import (
+    QuarantineLog, StabilityError, StabilitySentinel,
+)
+from paddle_tpu.profiler import flight
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    inject.disarm()
+    for s in list(sentinel_mod._active):
+        s.close()
+    paddle.set_flags({
+        "FLAGS_lazy_async": True,
+        "FLAGS_stability_enable": False,
+        "FLAGS_check_nan_inf": False,
+        "FLAGS_shard_weight_update": True,
+    })
+    lazy.set_lazy_mode(True)
+
+
+# -- deterministic micro training loop ----------------------------------------
+def _data_for(step):
+    rng = np.random.RandomState(1000 + step)
+    return rng.randn(8, 4).astype(np.float32), rng.randn(8, 1).astype(np.float32)
+
+
+def _sentinel(anchor=None, **kw):
+    cfg = dict(window=32, warmup=3, zmax=50.0, max_skips=2, max_rollbacks=2,
+               cooldown=4)
+    cfg.update(kw)
+    return StabilitySentinel(anchor=anchor, **cfg)
+
+
+def _run(steps=8, spike=None, pre_q=(), async_on=True, anchor_dir=None,
+         sched=False, on_verdict=None, **sentinel_kw):
+    """Sentinel-guarded loop over per-step deterministic data. ``pre_q``
+    pre-quarantines positions — the reference "uninterrupted run trained on
+    the same data with the quarantined batch excluded"."""
+    paddle.set_flags({"FLAGS_lazy_async": async_on})
+    inject.disarm()
+    if spike:
+        inject.arm(spike)
+    w = paddle.to_tensor(np.full((4, 1), 0.5, np.float32))
+    w.stop_gradient = False
+    lr = paddle.optimizer.lr.StepDecay(0.05, step_size=3) if sched else 0.05
+    opt = paddle.optimizer.Adam(learning_rate=lr, parameters=[w])
+    anchor = (AutoCheckpoint(anchor_dir, interval_steps=1, keep_last=2)
+              if anchor_dir else None)
+    sent = _sentinel(anchor=anchor, **sentinel_kw)
+    for pos in pre_q:
+        sent.quarantine.add(-1, pos=pos, action="skip")
+    state = {"w": w, "opt": opt}
+    step = 0
+    events = []
+    try:
+        while step < steps:
+            if sent.is_quarantined(pos=(0, step)):
+                step += 1
+                continue
+            x, y = _data_for(step)
+            xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+            loss = ((paddle.matmul(xt, w) - yt) ** 2).mean()
+            s = inject.spike("loss.spike", step=step)
+            if s is not None:
+                loss = loss * s
+            loss.backward()
+            s = inject.spike("grad.spike", step=step)
+            if s is not None:
+                w.grad._set_data((w.grad * s)._data)
+            v = sent.observe(step, loss=loss, grads=[w.grad], params=[w],
+                             lr=opt.get_lr(), pos=(0, step))
+            if v is not None:
+                events.append(v)
+                opt.clear_grad()
+                if on_verdict is not None:
+                    on_verdict(v, w)
+                if v.action == "skip" and v.step == step:
+                    step += 1
+                    continue
+                if v.action == "rollback":
+                    step = sent.rollback(v, state) + 1
+                    continue
+                sent.halt(v)
+            opt.step()
+            opt.clear_grad()
+            if sched:
+                opt._learning_rate.step()
+            step += 1
+            sent.maybe_anchor(step - 1, state)
+    finally:
+        sent.close()
+        inject.disarm()
+    moments = {k: np.asarray(lazy.concrete(v)).copy()
+               for k, v in opt._accumulators[id(w)].items()}
+    return {
+        "events": events,
+        "quarantine": sent.quarantine.entries(),
+        "w": np.asarray(w.numpy()).copy(),
+        "moments": moments,
+        "opt_step": opt._step_count,
+        "lr_state": (opt._learning_rate.state_dict() if sched else None),
+        "sentinel": sent,
+    }
+
+
+def _assert_state_equal(a, b):
+    np.testing.assert_array_equal(a["w"], b["w"])
+    assert a["opt_step"] == b["opt_step"]
+    for k in a["moments"]:
+        np.testing.assert_array_equal(a["moments"][k], b["moments"][k])
+    assert a["lr_state"] == b["lr_state"]
+
+
+# -- robust statistics --------------------------------------------------------
+class TestStats:
+    def test_warmup_never_trips_and_folds(self):
+        st = sentinel_mod._SignalStats(window=16, warmup=4, zmax=3.0)
+        for v in (1.0, 100.0, 1.0, 50.0):  # wild warmup values: no trips
+            assert st.judge(v) == (False, 0.0)
+        assert len(st._ring) == 4
+
+    def test_spike_trips_and_is_not_folded(self):
+        st = sentinel_mod._SignalStats(window=16, warmup=4, zmax=8.0)
+        for v in (1.0, 1.1, 0.9, 1.05, 1.0, 0.95):
+            st.judge(v)
+        n = len(st._ring)
+        bad, z = st.judge(1000.0)
+        assert bad and z > 8.0
+        assert len(st._ring) == n  # the outlier must not shift the baseline
+        bad, _ = st.judge(1.02)  # healthy values keep flowing
+        assert not bad
+
+    def test_nonfinite_always_anomalous(self):
+        st = sentinel_mod._SignalStats(window=16, warmup=100, zmax=1e9)
+        assert st.judge(float("nan"))[0] is True
+        assert st.judge(float("inf"))[0] is True
+
+
+# -- policy ladder: skip (synchronous detection) ------------------------------
+class TestSkip:
+    def test_sync_mode_skip_is_bit_identical_to_excluding_the_batch(self):
+        spiked = _run(8, spike="grad.spike:step=4,scale=100000", async_on=False)
+        ref = _run(8, pre_q=[(0, 4)], async_on=False)
+        _assert_state_equal(spiked, ref)
+        (v,) = spiked["events"]
+        assert v.action == "skip" and v.step == 4 and not v.late
+        # a gradient spike moves both gradient-derived signals; the verdict
+        # names the worst-scoring one
+        assert v.signal in ("grad_norm", "upd_ratio")
+
+    def test_quarantine_log_names_signals_and_position(self):
+        before = profiler.counters().get("stability_skips", 0)
+        spiked = _run(8, spike="loss.spike:step=5,scale=1000000", async_on=False)
+        (entry,) = spiked["quarantine"]
+        assert entry["step"] == 5 and entry["pos"] == [0, 5]
+        assert entry["action"] == "skip"
+        assert entry["signals"]["loss"] > 1e3  # the condemning values ride along
+        assert set(entry["signals"]) == set(sentinel_mod.SIGNALS)
+        assert profiler.counters()["stability_skips"] == before + 1
+
+    def test_quarantine_dir_flag_persists_jsonl(self, tmp_path):
+        paddle.set_flags(
+            {"FLAGS_stability_quarantine_dir": str(tmp_path / "q")})
+        try:
+            _run(8, spike="loss.spike:step=5,scale=1000000", async_on=False)
+        finally:
+            paddle.set_flags({"FLAGS_stability_quarantine_dir": ""})
+        files = list((tmp_path / "q").glob("quarantine_*.jsonl"))
+        assert files
+        (rec,) = [json.loads(l) for l in files[0].read_text().splitlines()]
+        assert rec["step"] == 5 and rec["action"] == "skip"
+        assert rec["signals"]["loss"] > 1e3
+
+    def test_skip_budget_exhaustion_escalates(self, tmp_path):
+        # two spiked steps with max_skips=1: first skips, second rolls back
+        out = _run(
+            10,
+            spike="grad.spike:step=4,scale=100000;loss.spike:step=5,scale=1000000",
+            async_on=False, anchor_dir=str(tmp_path / "a"), max_skips=1,
+        )
+        actions = [v.action for v in out["events"]]
+        assert actions == ["skip", "rollback"]
+        assert {e["step"] for e in out["quarantine"]} == {4, 5}
+
+
+# -- policy ladder: rollback (deferred detection — the PR 6 caveat closed) ----
+class TestRollback:
+    def test_lazy_async_nonfinite_trip_recovered_bit_identical(self, tmp_path):
+        """PR 6 satellite: under FLAGS_lazy_async the non-finite trip
+        surfaces ≤1 step late — the poisoned update has COMMITTED (asserted
+        on the live weights at verdict time) — and sentinel rollback still
+        recovers bit-identically to a run that skipped the batch up front."""
+        poisoned_seen = []
+
+        def on_verdict(v, w):
+            if v.action == "rollback":
+                poisoned_seen.append(
+                    not np.isfinite(np.asarray(lazy.concrete(w._data))).all()
+                )
+
+        spiked = _run(
+            8, spike="grad.spike:step=4,nonfinite=1", async_on=True,
+            anchor_dir=str(tmp_path / "a"), on_verdict=on_verdict,
+        )
+        ref = _run(8, pre_q=[(0, 4)], async_on=True,
+                   anchor_dir=str(tmp_path / "b"))
+        _assert_state_equal(spiked, ref)
+        (v,) = spiked["events"]
+        assert v.action == "rollback" and v.step == 4 and v.late
+        assert v.signal == "nonfinite"
+        assert poisoned_seen == [True]  # the update really had committed
+        (entry,) = spiked["quarantine"]
+        assert entry["action"] == "rollback" and entry["pos"] == [0, 4]
+        assert np.isfinite(spiked["w"]).all()
+
+    def test_finite_spike_rolls_back_with_lr_scheduler_state(self, tmp_path):
+        spiked = _run(9, spike="loss.spike:step=5,scale=1000000", async_on=True,
+                      anchor_dir=str(tmp_path / "a"), sched=True)
+        ref = _run(9, pre_q=[(0, 5)], async_on=True,
+                   anchor_dir=str(tmp_path / "b"), sched=True)
+        assert spiked["lr_state"] is not None
+        _assert_state_equal(spiked, ref)
+
+    def test_rollback_skips_anchor_saved_in_detection_window(self, tmp_path):
+        """An anchor saved at the poisoned step itself carries the bad
+        update; resume(max_step=...) must walk past it and the rollback must
+        invalidate it (a quarantined step is never re-saved by the replay)."""
+        out = _run(8, spike="grad.spike:step=4,scale=1000000", async_on=True,
+                   anchor_dir=str(tmp_path / "a"))
+        (v,) = out["events"]
+        assert v.action == "rollback" and v.step == 4
+        # the poisoned step-4 anchor was invalidated by the rollback (the
+        # quarantined step is never replayed, so it would otherwise shadow
+        # future rollbacks forever)
+        assert not os.path.isdir(os.path.join(str(tmp_path / "a"), "step_4"))
+        # the replay's clean anchors took over as the resume frontier
+        ac = AutoCheckpoint(str(tmp_path / "a"), interval_steps=1)
+        w2 = paddle.to_tensor(np.zeros((4, 1), np.float32))
+        assert ac.resume({"w": w2}) == 7
+        np.testing.assert_array_equal(w2.numpy(), out["w"])
+
+    def test_no_anchor_degrades_to_halt(self):
+        with pytest.raises(StabilityError, match="sentinel halt"):
+            _run(8, spike="grad.spike:step=4,scale=1000000", async_on=True,
+                 max_skips=0)
+
+
+# -- policy ladder: halt ------------------------------------------------------
+class TestHalt:
+    def test_halt_dumps_flight_postmortem_naming_signal(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        before = profiler.counters().get("stability_halts", 0)
+        with pytest.raises(StabilityError) as ei:
+            _run(8, spike="loss.spike:step=4,scale=1000000", async_on=False,
+                 max_skips=0, max_rollbacks=0)
+        assert ei.value.verdict.signal == "loss"
+        assert ei.value.history  # signal history rides the exception
+        doc = json.load(open(flight.last_dump()))
+        assert doc["reason"] == "stability_halt"
+        assert doc["extra"]["signal"] == "loss"
+        assert doc["extra"]["verdict"]["step"] == 4
+        assert len(doc["extra"]["history"]) >= 3
+        # the registered context provider adds the sentinel view to any dump
+        assert "stability" in doc["context"]
+        assert profiler.counters()["stability_halts"] == before + 1
+
+
+# -- anchor pinning (satellite 1) ---------------------------------------------
+class TestAnchorPinning:
+    def test_gc_never_collects_protected_anchor(self, tmp_path):
+        """keep_last=1 + an anchor OLDER than the window: without the pin,
+        GC collects the only checkpoint the sentinel could roll back to."""
+        ac = AutoCheckpoint(str(tmp_path / "a"), interval_steps=1, keep_last=1)
+        w = paddle.to_tensor(np.zeros(3, np.float32))
+        w._set_data((w + 1.0)._data)
+        ac.maybe_save(1, {"w": w})
+        ac.protect(1)
+        for s in (2, 3, 4):
+            w._set_data((w + 1.0)._data)
+            ac.maybe_save(s, {"w": w})
+        assert os.path.isdir(ac._step_path(1))  # pinned: survived keep_last=1
+        assert not os.path.isdir(ac._step_path(2))  # unpinned: collected
+        w2 = paddle.to_tensor(np.zeros(3, np.float32))
+        assert ac.resume({"w": w2}, max_step=1) == 1
+        np.testing.assert_array_equal(w2.numpy(), np.full(3, 1.0))
+        # release: the next save's GC drops it
+        ac.release(1)
+        w._set_data((w + 1.0)._data)
+        ac.maybe_save(5, {"w": w})
+        assert not os.path.isdir(ac._step_path(1))
+
+    def test_invalidate_refuses_protected_anchor(self, tmp_path):
+        ac = AutoCheckpoint(str(tmp_path / "a"), interval_steps=1, keep_last=2)
+        w = paddle.to_tensor(np.ones(2, np.float32))
+        ac.maybe_save(1, {"w": w})
+        ac.protect(1)
+        with pytest.raises(ValueError, match="protected"):
+            ac.invalidate(1)
+        ac.release(1)
+        ac.invalidate(1)
+        assert not os.path.isdir(ac._step_path(1))
+
+    def test_sentinel_pins_only_judged_clean_anchors(self, tmp_path):
+        """The pin trails the judgment horizon: an anchor saved at a step
+        whose signals have not been judged clean yet is not pinned."""
+        ac = AutoCheckpoint(str(tmp_path / "a"), interval_steps=1, keep_last=2)
+        sent = _sentinel(anchor=ac)
+        try:
+            w = paddle.to_tensor(np.ones(2, np.float32))
+            for step in range(1, 4):
+                # committed observations defer judgment by one step — the
+                # anchor at step N lands before step N's signals are judged
+                sent.observe(step, loss=paddle.to_tensor(np.float32(1.0)),
+                             committed=True)
+                sent.maybe_anchor(step, {"w": w})
+            assert sent._pinned == 2  # step 3's anchor saved BEFORE judgment
+            sent.poll()  # judge the last deferred entry clean
+            assert sent._pinned == 3
+        finally:
+            sent.close()
+
+
+# -- spike injection points (satellite 3) -------------------------------------
+class TestSpikePoints:
+    def test_grammar_and_determinism(self):
+        inject.arm("loss.spike:step=3,scale=7;grad.spike:at=2,nonfinite=1")
+        assert inject.spike("loss.spike", step=2) is None
+        assert inject.spike("loss.spike", step=3) == 7.0
+        assert inject.spike("grad.spike") is None        # call 1
+        assert inject.spike("grad.spike") == float("inf")  # call 2 == at
+        inject.disarm()
+        assert inject.spike("loss.spike", step=3) is None
+
+    def test_non_spike_point_rejected(self):
+        with pytest.raises(KeyError, match="spike"):
+            inject.spike("ckpt.write")
+
+    def test_unknown_point_name_rejected_by_arm(self):
+        with pytest.raises(KeyError, match="loss.spike"):
+            inject.arm({"loss.spke": {}})
+
+
+# -- hapi.Model.fit wiring ----------------------------------------------------
+class _XYDataset(paddle.io.Dataset):
+    def __init__(self, n=32):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        self.y = self.x.sum(axis=1, keepdims=True).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class TestFitIntegration:
+    def _fit(self, tmp_path, tag, spike=None, pre_q=(), **sentinel_kw):
+        inject.disarm()
+        if spike:
+            inject.arm(spike)
+        paddle.seed(7)
+        net = nn.Linear(8, 1)
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(
+                learning_rate=0.05, parameters=net.parameters()),
+            loss=lambda pred, y: F.mse_loss(pred, y),
+        )
+        loader = paddle.io.DataLoader(_XYDataset(), batch_size=4, shuffle=True,
+                                      seed=99)
+        anchor = AutoCheckpoint(str(tmp_path / tag), interval_steps=1,
+                                keep_last=2)
+        sent = _sentinel(anchor=anchor, zmax=60, **sentinel_kw)
+        for pos in pre_q:
+            sent.quarantine.add(-1, pos=pos, action="skip")
+        try:
+            model.fit(loader, epochs=2, verbose=0, stability=sent)
+        finally:
+            sent.close()
+            inject.disarm()
+        return sent, [np.asarray(p.numpy()).copy() for p in net.parameters()]
+
+    def test_rollback_parity_and_index_level_skip(self, tmp_path):
+        skips0 = profiler.counters().get("io_quarantine_skips", 0)
+        s1, p1 = self._fit(tmp_path, "a", spike="grad.spike:step=5,scale=1000000")
+        s2, p2 = self._fit(tmp_path, "b", pre_q=[(0, 5)])
+        for a, b in zip(p1, p2):
+            np.testing.assert_array_equal(a, b)
+        (entry,) = s1.quarantine.entries()
+        assert entry["pos"] == [0, 5] and entry["action"] == "rollback"
+        # the quarantine log names the exact samples of the condemned batch
+        # (reconstructed from the seeded sampler), and the replay skipped it
+        # at the INDEX level (both runs exercise the skip path)
+        assert len(entry["sample_indices"]) == 4
+        assert profiler.counters()["io_quarantine_skips"] > skips0
+
+    def test_flags_enable_builds_sentinel_and_disabled_is_default_loop(self, tmp_path):
+        # FLAGS_stability_enable + ckpt dir: fit builds and closes its own
+        # sentinel; without the flag, fit must not touch the sentinel module
+        paddle.set_flags({
+            "FLAGS_stability_enable": True,
+            "FLAGS_stability_ckpt_dir": str(tmp_path / "fl"),
+            "FLAGS_stability_anchor_interval": 4,
+        })
+        try:
+            paddle.seed(7)
+            net = nn.Linear(8, 1)
+            model = paddle.Model(net)
+            model.prepare(
+                optimizer=paddle.optimizer.SGD(
+                    learning_rate=0.05, parameters=net.parameters()),
+                loss=lambda pred, y: F.mse_loss(pred, y),
+            )
+            before = profiler.counters().get("stability_observed", 0)
+            model.fit(_XYDataset(), batch_size=4, epochs=1, shuffle=False,
+                      verbose=0)
+            assert profiler.counters()["stability_observed"] > before
+            assert lazy._stability_tap is None  # fit closed its sentinel
+            assert os.path.isdir(str(tmp_path / "fl"))  # anchors landed
+        finally:
+            paddle.set_flags({
+                "FLAGS_stability_enable": False,
+                "FLAGS_stability_ckpt_dir": "",
+                "FLAGS_stability_anchor_interval": 25,
+            })
+
+
+# -- engine step path (with and without the ZeRO-1 sharded update) ------------
+@pytest.mark.multichip
+class TestEngineSentinel:
+    """Acceptance: the sentinel works through the engine's donated fused
+    step, where the update has COMMITTED by the time the loss is readable —
+    every trip escalates to rollback, restoring the engine-resident ZeRO
+    shards via engine_state_dict/engine_apply_state, with bit-identical
+    parity against a run that excluded the batch, both with and without
+    ``FLAGS_shard_weight_update``."""
+
+    def _batch_for(self, step):
+        rng = np.random.RandomState(500 + step)
+        return rng.randn(8, 8).astype(np.float32), rng.randn(8, 4).astype(np.float32)
+
+    def _run(self, wus, tmp_path, tag, spike=None, pre_q=(), steps=7):
+        import jax
+        from jax.sharding import Mesh
+
+        from paddle_tpu.distributed.engine import HybridParallelEngine
+
+        paddle.set_flags({"FLAGS_shard_weight_update": wus})
+        inject.disarm()
+        if spike:
+            inject.arm(spike)
+        paddle.seed(5)
+        m = nn.Linear(8, 4)
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=m.parameters())
+        mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+        eng = HybridParallelEngine(
+            m, opt, lambda mm, x, y: F.mse_loss(mm(x), y), mesh=mesh
+        )
+        anchor = AutoCheckpoint(str(tmp_path / tag), interval_steps=1,
+                                keep_last=2)
+        sent = StabilitySentinel.for_engine(
+            eng, anchor, window=32, warmup=2, zmax=50, max_skips=0,
+            max_rollbacks=2, cooldown=4,
+        )
+        for b in pre_q:
+            sent.quarantine.add(-1, pos=(0, b), action="skip")
+        ordinal = 0
+        ordinal_at_anchor = {}
+        rolled = []
+        try:
+            while ordinal < steps:
+                if sent.is_quarantined(pos=(0, ordinal)):
+                    ordinal += 1
+                    continue
+                x, y = self._batch_for(ordinal)
+                sent.note_batch((0, ordinal))
+                eng.train_step(x, y)
+                v = sent.take_verdict()
+                if v is not None:
+                    assert v.late  # committed observations can never skip
+                    if v.action == "rollback":
+                        a = sent.rollback(v)
+                        rolled.append((v.step, a))
+                        ordinal = ordinal_at_anchor.get(a, -1) + 1
+                        continue
+                    sent.halt(v)
+                if sent.maybe_anchor(opt._step_count):
+                    ordinal_at_anchor[opt._step_count] = ordinal
+                ordinal += 1
+            sent.poll()
+        finally:
+            sent.close()
+            inject.disarm()
+        eng.sync_optimizer_state()
+        params = [np.asarray(p.numpy()).copy() for p in m.parameters()]
+        moms = [
+            {k: np.asarray(lazy.concrete(v)).copy()
+             for k, v in opt._accumulators[id(p)].items()}
+            for p in m.parameters()
+        ]
+        return rolled, sent.quarantine.entries(), params, moms, opt._step_count
+
+    @pytest.mark.parametrize("wus", [False, True])
+    def test_spiked_batch_rolled_back_bit_identical(self, tmp_path, wus):
+        r1, q1, p1, m1, s1 = self._run(
+            wus, tmp_path, f"a{int(wus)}", spike="loss.spike:step=3,scale=1000000"
+        )
+        r2, q2, p2, m2, s2 = self._run(wus, tmp_path, f"b{int(wus)}", pre_q=[3])
+        assert r1 and not r2  # the spiked run rolled back, the reference never
+        (entry,) = q1
+        assert entry["pos"] == [0, 3] and entry["action"] == "rollback"
+        assert s1 == s2  # optimizer step counts agree (skipped batch absent)
+        for a, b in zip(p1, p2):
+            np.testing.assert_array_equal(a, b)
+        for d1, d2 in zip(m1, m2):
+            for k in d1:
+                np.testing.assert_array_equal(d1[k], d2[k])
+
+
+# -- tier-1 inert tripwire (satellite 6) --------------------------------------
+class TestInertTripwire:
+    def test_unconfigured_training_never_touches_the_detector(self, monkeypatch):
+        """No sentinel configured → the detector is NEVER called (exploded
+        here), the drain tap stays None, no new threads, no sentinel
+        readbacks — the disabled path is attribute probes only."""
+        def boom(*a, **k):
+            raise AssertionError("stability detector called without a sentinel")
+
+        monkeypatch.setattr(StabilitySentinel, "observe", boom)
+        monkeypatch.setattr(StabilitySentinel, "_judge", boom)
+        assert lazy._stability_tap is None
+        threads0 = threading.active_count()
+        reads0 = profiler.counters().get("stability_readbacks", 0)
+        obs0 = profiler.counters().get("stability_observed", 0)
+
+        # plain fit loop (flag off)
+        paddle.seed(0)
+        net = nn.Linear(8, 1)
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(
+                learning_rate=0.05, parameters=net.parameters()),
+            loss=lambda pred, y: F.mse_loss(pred, y),
+        )
+        model.fit(_XYDataset(16), batch_size=4, epochs=1, shuffle=False,
+                  verbose=0)
+        # plain lazy train steps (the tap probe in flush is all that runs)
+        w = paddle.to_tensor(np.ones((4, 1), np.float32))
+        w.stop_gradient = False
+        for step in range(3):
+            x, y = _data_for(step)
+            loss = ((paddle.matmul(paddle.to_tensor(x), w) - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            w._set_data((w - 0.1 * w.grad)._data)
+            w.clear_grad()
+            float(loss.item())
+
+        assert lazy._stability_tap is None
+        assert threading.active_count() == threads0
+        assert profiler.counters().get("stability_readbacks", 0) == reads0
+        assert profiler.counters().get("stability_observed", 0) == obs0
+
+    def test_close_disarms_tap_and_provider(self):
+        sent = _sentinel()
+        assert lazy._stability_tap is not None
+        sent.close()
+        assert lazy._stability_tap is None
+        # close is idempotent and the flight provider is gone
+        sent.close()
+        from paddle_tpu.profiler.flight import _context_providers
+
+        assert "stability" not in _context_providers
+
+
+# -- one-readback-per-step discipline -----------------------------------------
+class TestReadbackBudget:
+    def test_one_fused_readback_per_step(self):
+        """The sentinel's entire per-step host traffic is ONE 4-float
+        readback (the fused signal pack) riding the deferred drain."""
+        out = _run(6, async_on=True)
+        c = profiler.counters()
+        # 6 observes; the final pending handle is dropped at close (≤1 step
+        # late contract, nothing newer arrived) — so ≤1 readback per step
+        assert c.get("stability_readbacks", 0) >= 1
+        assert out["events"] == []
+
+    def test_signal_pack_rides_the_step_flush(self):
+        """In lazy mode the signal node fuses into the step's own flush —
+        observing must not add a flush of its own."""
+        paddle.set_flags({"FLAGS_lazy_async": True})
+        sent = _sentinel()
+        try:
+            w = paddle.to_tensor(np.full((4, 1), 0.5, np.float32))
+            w.stop_gradient = False
+            opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=[w])
+            # warm one step so the loop below is the steady state
+            x, y = _data_for(0)
+            loss = ((paddle.matmul(paddle.to_tensor(x), w) - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            sent.observe(0, loss=loss, grads=[w.grad], params=[w], lr=0.05)
+            opt.step()
+            opt.clear_grad()
+            flushes0 = profiler.counters().get("lazy_flushes", 0)
+            for step in range(1, 4):
+                x, y = _data_for(step)
+                loss = ((paddle.matmul(paddle.to_tensor(x), w) - paddle.to_tensor(y)) ** 2).mean()
+                loss.backward()
+                sent.observe(step, loss=loss, grads=[w.grad], params=[w], lr=0.05)
+                opt.step()
+                opt.clear_grad()
+            assert profiler.counters()["lazy_flushes"] - flushes0 == 3
+        finally:
+            sent.close()
