@@ -27,6 +27,16 @@ Each rule encodes an invariant a past incident or PR established:
 * ``bare-except`` — a bare ``except:`` (or ``except BaseException`` that
   does not re-raise) in retry/commit paths swallows ``KeyboardInterrupt``/
   ``SystemExit`` and can convert a preemption drain into a hang.
+* ``oom-handler`` — an ``except`` that can catch an ``XlaRuntimeError``
+  (bare, ``BaseException``, ``Exception``, ``RuntimeError``, or the type
+  itself) in the DISPATCH-LAYER files of ``core/``/``distributed/``/
+  ``serving/`` — the files where compiled executables actually launch —
+  must either re-raise or route through the ONE ``fault/memory.py``
+  classifier (``is_oom``/``classify``/``note_oom``/``maybe_hbm_oom``/a
+  ``_recover_oom``-family helper). A broad handler that silently eats a
+  ``RESOURCE_EXHAUSTED`` (e.g. into an unfused eager replay) turns a
+  recoverable exhaustion into data-dependent wrong behavior; PR 14 made
+  OOM a managed condition and this rule keeps it that way.
 
 Suppression grammar: ``# lint: ok(<rule>)`` on the offending line (or the
 line directly above it). Grandfathered findings live in ``baseline.txt`` —
@@ -47,7 +57,7 @@ __all__ = [
 
 RULES = (
     "host-sync", "compat-shim", "atomic-write", "monotonic-deadline",
-    "flag-registry", "bare-except",
+    "flag-registry", "bare-except", "oom-handler",
 )
 
 # host-sync applies only to hot-path packages (metric/, hapi/ etc. read
@@ -65,6 +75,22 @@ _WRITE_MODES = ("w", "wb", "w+", "wb+", "x", "xb")
 _MUTATING_WRITES = {"write_bytes", "write_text"}
 _EXCEPT_SCOPE = ("fault/", "distributed/checkpoint.py", "distributed/coord.py",
                  "distributed/watchdog.py")
+# oom-handler applies to the dispatch layer inside core//distributed//
+# serving/ — the files where compiled executables launch and an
+# XlaRuntimeError(RESOURCE_EXHAUSTED) can actually surface. A broad handler
+# elsewhere in those packages has nothing device-dispatching in its try.
+_OOM_SCOPE = (
+    "core/lazy.py", "core/dispatch.py", "distributed/engine.py",
+    "serving/engine.py", "serving/supervisor.py",
+)
+# exception types a RESOURCE_EXHAUSTED can hide behind
+_OOM_TYPES = {"Exception", "BaseException", "RuntimeError", "XlaRuntimeError"}
+# fault/memory.py classifier surface (plus the per-layer ladder helpers that
+# route through it) — any of these in the handler body satisfies the rule
+_OOM_ROUTERS = {
+    "is_oom", "classify", "note_oom", "_note_oom", "_oom_recover",
+    "_recover_oom", "_on_oom", "maybe_hbm_oom",
+}
 
 
 class Finding:
@@ -373,7 +399,41 @@ class _Linter(_ScopeVisitor):
             )
         self.generic_visit(node)
 
+    @staticmethod
+    def _oom_catchable(t) -> bool:
+        """Could this except-type clause see an XlaRuntimeError?"""
+        if t is None:
+            return True  # bare except
+        if isinstance(t, ast.Tuple):
+            return any(_Linter._oom_catchable(x) for x in t.elts)
+        dn = _dotted(t)
+        return dn is not None and dn.rsplit(".", 1)[-1] in _OOM_TYPES
+
     def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if self.relpath in _OOM_SCOPE and self._oom_catchable(node.type):
+
+            def _callee(c):
+                dn = _dotted(c.func)
+                if dn:
+                    return dn.rsplit(".", 1)[-1]
+                return c.func.attr if isinstance(c.func, ast.Attribute) else None
+
+            reraises = any(
+                isinstance(s, ast.Raise) and s.exc is None
+                for s in ast.walk(node)
+            )
+            routed = any(
+                isinstance(s, ast.Call) and _callee(s) in _OOM_ROUTERS
+                for s in ast.walk(node)
+            )
+            if not reraises and not routed:
+                self._emit(
+                    "oom-handler", node,
+                    "broad except in a dispatch-layer file can swallow an "
+                    "XlaRuntimeError(RESOURCE_EXHAUSTED); re-raise or route "
+                    "through the fault/memory.py classifier (is_oom/"
+                    "classify/note_oom)",
+                )
         if self._in_except_scope():
             bare = node.type is None
             base = (

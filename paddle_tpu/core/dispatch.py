@@ -185,7 +185,7 @@ def _nonfinite_error(name, idx, arr, origin="eager", hint=False, extra=None):
                 "message": msg, **(extra or {}),
             },
         )
-    except Exception:
+    except Exception:  # lint: ok(oom-handler) — flight-dump guard, nothing dispatches in this try
         pass
     return FloatingPointError(msg)
 
@@ -220,22 +220,39 @@ def eager_call(
     output positions excluded from the vjp capture.
     """
     p = _prof()
-    if p._enabled:
-        _t0 = _time.perf_counter_ns()
-        try:
+    try:
+        if p._enabled:
+            _t0 = _time.perf_counter_ns()
+            try:
+                res = _eager_call_impl(
+                    name, fn, tensor_args, attrs, differentiable,
+                    nondiff_outputs, fn_key,
+                )
+            finally:
+                p._record("op::" + name, _t0)
+        else:
             res = _eager_call_impl(
-                name, fn, tensor_args, attrs, differentiable,
-                nondiff_outputs, fn_key,
+                name, fn, tensor_args, attrs, differentiable, nondiff_outputs, fn_key
             )
-        finally:
-            p._record("op::" + name, _t0)
-    else:
-        res = _eager_call_impl(
-            name, fn, tensor_args, attrs, differentiable, nondiff_outputs, fn_key
-        )
+    except Exception as e:
+        # a RESOURCE_EXHAUSTED on the per-op path is classified (counter +
+        # flight context) before it propagates — there is no per-op retry
+        # rung; the flush/engine ladders own recovery (fault/memory.py)
+        _note_oom(e, "eager:" + name)
+        raise
     if _fault_inject is not None and _fault_inject.should_fire("tensor.nan", op=name):
         _fault_inject.poison_first_nan(res)
     return res
+
+
+def _note_oom(e: BaseException, where: str) -> None:
+    """Route a possible device-memory exhaustion through the ONE classifier
+    (fault/memory.py). Import is lazy and only on the exception path — the
+    unconfigured hot loop never touches the module (inert tripwire)."""
+    from ..fault import memory as _mem
+
+    if _mem.is_oom(e):
+        _mem.note_oom(where, e)
 
 
 def _eager_call_impl(

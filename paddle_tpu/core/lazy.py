@@ -76,7 +76,7 @@ import jax.numpy as jnp
 __all__ = [
     "LazyArray", "record", "flush", "sync", "lazy_enabled", "set_lazy_mode",
     "lazy_guard", "is_lazy", "maybe_lazy_binary", "lazy_full",
-    "note_rebound", "timed_block",
+    "note_rebound", "timed_block", "evict_cold",
 ]
 
 _state = threading.local()
@@ -590,6 +590,21 @@ _flush_cache: "collections.OrderedDict" = collections.OrderedDict()  # guarded_b
 _FLUSH_CACHE_MAX = 128
 
 
+def evict_cold(keep: int = 4) -> int:
+    """Drop cold executable-cache entries, keeping the ``keep`` most
+    recently used — the lazy runtime's pressure-relief rung
+    (fault/memory.free_pressure): a compiled program pins its constants and
+    workspace, so under RESOURCE_EXHAUSTED the cold tail is the cheapest
+    memory to give back (an evicted signature merely recompiles if it ever
+    comes back). Returns the number evicted."""
+    n = 0
+    with _cache_lock:
+        while len(_flush_cache) > max(int(keep), 0):
+            _flush_cache.popitem(last=False)
+            n += 1
+    return n
+
+
 def _interp(fns, wiring, leaf_vals, on_node=None):
     """The one interpreter for the graph wiring descriptors
     (``("l", leaf_ix)`` / ``("n", node_ix, out_ix)``): used traced inside the
@@ -687,7 +702,7 @@ def timed_block(x, where: str = "readback"):
         try:
             if all(a.is_ready() for a in arrs):
                 return x
-        except Exception:
+        except Exception:  # lint: ok(oom-handler) — readiness probe, nothing dispatches in this try
             pass
         _timed_block(arrs, where)
         return x
@@ -698,7 +713,7 @@ def timed_block(x, where: str = "readback"):
     try:
         if x.is_ready():  # committed futures skip the span entirely
             return x
-    except Exception:
+    except Exception:  # lint: ok(oom-handler) — readiness probe, nothing dispatches in this try
         pass
     return _timed_block(x, where)
 
@@ -792,6 +807,10 @@ class _BgCompile:
                 )
                 self.value = jf.lower(*leaves).compile()
             except Exception as e:  # surfaced as a sync-compile fallback
+                from ..fault import memory as _mem
+
+                if _mem.is_oom(e):  # compile-time RESOURCE_EXHAUSTED counts
+                    _mem.note_oom("lazy_bg_compile", e)
                 self.error = e
             finally:
                 self.ready = True  # publish AFTER value/error (GIL ordering)
@@ -855,6 +874,10 @@ def _flush_impl(g: _Graph, sp=None):
 
     check_nan = bool(_flags.flag("FLAGS_check_nan_inf", False))
     async_on = bool(_flags.flag("FLAGS_lazy_async", True))
+    # HBM preflight admission (fault/memory.py): "off" (default) costs this
+    # one probe — fault.memory is never imported, no census runs, the
+    # executable compiles through the plain jax.jit path (inert tripwire)
+    admission = _flags.flag("FLAGS_hbm_admission", "off")
     donate_ix: tuple = ()
     cand = getattr(_state, "donate_ids", None)
     if cand and _flags.flag("FLAGS_lazy_donate", True):
@@ -868,6 +891,11 @@ def _flush_impl(g: _Graph, sp=None):
             with _spans().span("donate", candidates=len(cand)) as dsp:
                 donate_ix = _donation_mask(leaves, cand, g.direct_uses)
                 dsp.set(donated=len(donate_ix))
+    # snapshot for the preflight-rejection path: a rejected dispatch must
+    # put the donation intent back, or the retry flush would re-key (and
+    # recompile) WITHOUT donation — a bigger footprint exactly when memory
+    # is tightest
+    cand_snapshot = set(cand) if cand else None
     if cand:
         cand.clear()
 
@@ -905,6 +933,7 @@ def _flush_impl(g: _Graph, sp=None):
             cache="hit" if cache_hit else "miss",
             cache_key=(f"{hash(sig) & 0xFFFFFFFFFFFFFFFF:016x}" if sig is not None else None),
         )
+    precompiled = False
     if entry is None:
         fns = [n2.fn for n2 in nodes]
         wiring = descs_all
@@ -926,9 +955,39 @@ def _flush_impl(g: _Graph, sp=None):
         ):
             # compile off-thread; THIS step (and any same-signature step
             # until the compile lands) completes via the un-jitted replay
+            # (no memory prediction until the pickup — admission skips it)
             task = _BgCompile(replay, donate_ix, list(leaves))
-            entry = [None, live, replay, donate_ix, task]
+            entry = [None, live, replay, donate_ix, task, None]
             prof.counter_inc("lazy_bg_compiles")
+        elif admission != "off":
+            # admission needs the executable's memory_analysis BEFORE the
+            # first dispatch: compile ahead-of-time (the bg-compile pickup
+            # shape — entry[0] is an AOT Compiled, the aot fallback rung
+            # re-traces on aval drift) and key the prediction like the
+            # executable cache
+            from ..fault import memory as _hbm
+
+            jf = (
+                jax.jit(replay, donate_argnums=donate_ix)
+                if donate_ix
+                else jax.jit(replay)
+            )
+            with _spans().span("compile", cache="miss", admission=admission) as csp:
+                compiled = jf.lower(*leaves).compile()
+                mem = _hbm.analyze_compiled(
+                    compiled,
+                    key=(f"{hash(sig) & 0xFFFFFFFFFFFFFFFF:016x}"
+                         if sig is not None else None),
+                )
+                if mem is not None:
+                    csp.set(
+                        hbm_exec_peak_bytes=mem["peak_bytes"],
+                        hbm_temp_bytes=mem["temp_bytes"],
+                        hbm_output_bytes=mem["output_bytes"],
+                        hbm_alias_bytes=mem["alias_bytes"],
+                    )
+            entry = [compiled, live, replay, donate_ix, None, mem]
+            precompiled = True
         else:
             jitted = (
                 jax.jit(replay, donate_argnums=donate_ix)
@@ -937,7 +996,7 @@ def _flush_impl(g: _Graph, sp=None):
             )
             # list, not tuple: the donation-error fallback swaps in a
             # non-donating executable under the same signature
-            entry = [jitted, live, replay, donate_ix, None]
+            entry = [jitted, live, replay, donate_ix, None, None]
         if sig is not None:
             with _cache_lock:
                 _flush_cache[sig] = entry
@@ -946,12 +1005,13 @@ def _flush_impl(g: _Graph, sp=None):
     else:
         prof.counter_inc("lazy_cache_hits")
 
-    jitted, live, replay, don, task = entry
+    jitted, live, replay, don, task = entry[:5]
+    mem_pred = entry[5] if len(entry) > 5 else None
+    donated_bytes = (
+        sum(int(getattr(leaves[j], "nbytes", 0)) for j in don) if don else 0
+    )
     if sp is not None and don:
-        sp.set(
-            donated_buffers=len(don),
-            donated_bytes=sum(int(getattr(leaves[j], "nbytes", 0)) for j in don),
-        )
+        sp.set(donated_buffers=len(don), donated_bytes=donated_bytes)
     if jitted is None and task is not None:
         # background compile in flight: pick it up if finished, else keep
         # stepping through the replay fallback
@@ -972,9 +1032,68 @@ def _flush_impl(g: _Graph, sp=None):
                 prof.counter_inc("lazy_bg_compile_failures")
                 if sp is not None:
                     sp.set(bg_compile="failed", bg_error=type(task.error).__name__)
+    if (
+        admission != "off"
+        and mem_pred is None
+        and task is None
+        and jitted is not None
+        and hasattr(jitted, "lower")
+    ):
+        # cache entry predates the admission flag flip (or was built by the
+        # plain path): upgrade it IN PLACE once — lower+compile the same
+        # jitted (donation mask already baked in; the persistent compilation
+        # cache makes this warm) and capture its memory analysis
+        from ..fault import memory as _hbm
+
+        try:
+            with _spans().span("compile", cache="upgrade", admission=admission) as csp:
+                compiled = jitted.lower(*leaves).compile()
+                mem_pred = _hbm.analyze_compiled(
+                    compiled,
+                    key=(f"{hash(sig) & 0xFFFFFFFFFFFFFFFF:016x}"
+                         if sig is not None else None),
+                )
+                if mem_pred is not None:
+                    csp.set(hbm_exec_peak_bytes=mem_pred["peak_bytes"])
+            entry[0] = jitted = compiled
+            if len(entry) > 5:
+                entry[5] = mem_pred
+            precompiled = True
+        except Exception as e:
+            if _hbm.is_oom(e):  # even the upgrade compile can exhaust HBM
+                _hbm.note_oom("lazy_flush.compile", e)
+                raise
+            mem_pred = None  # no prediction; admission admits, dispatch as-is
+
     # a bg-compile pickup leaves an AOT Compiled in entry[0]; unlike jax.jit
     # it cannot re-trace, so execution failures get an extra fallback rung
     aot = jitted is not None and not hasattr(jitted, "lower")
+
+    if admission != "off" and jitted is not None:
+        # predicted peak + live census vs the device budget, BEFORE the
+        # device is touched. An enforce rejection reinstates the pending
+        # epoch: nothing was dispatched, so the caller can free memory or
+        # raise the budget and simply flush again.
+        from ..fault import memory as _hbm
+
+        try:
+            _hbm.preflight(
+                mem_pred, "lazy_flush", span=sp, donated_bytes=donated_bytes
+            )
+        except Exception:
+            cur = getattr(_state, "graph", None)
+            if cur is None or not cur.nodes:
+                _state.graph = g
+            if cand_snapshot:
+                # restore the donation intent too: the retry flush then
+                # re-derives the SAME donation mask → same signature →
+                # cache hit on this already-compiled (donating) executable
+                s = getattr(_state, "donate_ids", None)
+                if s is None:
+                    s = set()
+                    _state.donate_ids = s
+                s.update(cand_snapshot)
+            raise
 
     results = None
     if jitted is None:
@@ -988,13 +1107,21 @@ def _flush_impl(g: _Graph, sp=None):
         try:
             if don:
                 _ignore_donation_warnings()
-            # a miss pays trace+compile inside this first invocation; a hit
-            # is a pure executable launch — with the async runtime the host
-            # RETURNS at dispatch ("dispatch" span), only the sync kill-switch
-            # path keeps the old "execute" attribution
+            from .dispatch import _fault_inject as _finj
+
+            if _finj is not None:
+                # hbm.oom chaos: the synthesized RESOURCE_EXHAUSTED raises
+                # from inside this try, so the recovery ladder below handles
+                # it exactly like a real device OOM
+                _finj.maybe_hbm_oom("lazy_flush")
+            # a miss pays trace+compile inside this first invocation (unless
+            # admission already compiled ahead-of-time); a hit is a pure
+            # executable launch — with the async runtime the host RETURNS at
+            # dispatch ("dispatch" span), only the sync kill-switch path
+            # keeps the old "execute" attribution
             span_name = (
                 "compile"
-                if not cache_hit
+                if not cache_hit and not precompiled
                 else ("dispatch" if async_on else "execute")
             )
             with _spans().span(
@@ -1003,35 +1130,48 @@ def _flush_impl(g: _Graph, sp=None):
                 results = jitted(*leaves)
             if don:
                 prof.counter_inc("lazy_donated_buffers", len(don))
-        except Exception:
-            donated_dead = any(
-                getattr(l, "is_deleted", _false)()
-                for l in leaves
-                if isinstance(l, jax.Array)
-            )
-            if aot and not donated_dead:
-                # AOT executables (bg-compile pickups) don't re-trace on an
-                # input-aval drift the way jax.jit does — swap in the
-                # polymorphic jit under the same signature and retry
-                prof.counter_inc("lazy_bg_aot_fallbacks")
-                if sp is not None:
-                    sp.set(fallback="aot_retrace")
-                jitted = entry[0] = (
-                    jax.jit(replay, donate_argnums=don) if don else jax.jit(replay)
+        except Exception as e:
+            from ..fault import memory as _hbm
+
+            if _hbm.is_oom(e):
+                # RESOURCE_EXHAUSTED: classify → free pressure → retry once
+                # → structured halt. NEVER the eager-replay fallback — an
+                # unfused replay of an OOM'd graph would OOM harder on a
+                # real device (and silently un-fuse on CPU tests).
+                results = _oom_recover(e, entry, leaves, sp, prof)
+            else:
+                donated_dead = any(
+                    getattr(l, "is_deleted", _false)()
+                    for l in leaves
+                    if isinstance(l, jax.Array)
                 )
-                try:
-                    with _spans().span("compile", cache="miss", fallback="aot_retrace"):
-                        results = jitted(*leaves)
-                    if don:
-                        prof.counter_inc("lazy_donated_buffers", len(don))
-                except Exception:
+                if aot and not donated_dead:
+                    # AOT executables (bg-compile pickups / admission
+                    # precompiles) don't re-trace on an input-aval drift the
+                    # way jax.jit does — swap in the polymorphic jit under
+                    # the same signature and retry
+                    prof.counter_inc("lazy_bg_aot_fallbacks")
+                    if sp is not None:
+                        sp.set(fallback="aot_retrace")
+                    jitted = entry[0] = (
+                        jax.jit(replay, donate_argnums=don) if don else jax.jit(replay)
+                    )
+                    try:
+                        with _spans().span("compile", cache="miss", fallback="aot_retrace"):
+                            results = jitted(*leaves)
+                        if don:
+                            prof.counter_inc("lazy_donated_buffers", len(don))
+                    except Exception as e2:
+                        if _hbm.is_oom(e2):
+                            results = _oom_recover(e2, entry, leaves, sp, prof)
+                        else:
+                            results = _fallback_execute(
+                                entry, leaves, replay, don, donated_dead, sp, prof
+                            )
+                else:
                     results = _fallback_execute(
                         entry, leaves, replay, don, donated_dead, sp, prof
                     )
-            else:
-                results = _fallback_execute(
-                    entry, leaves, replay, don, donated_dead, sp, prof
-                )
 
     for (i, j), val in zip(live, results):
         o = nodes[i].out_refs[j]()
@@ -1106,7 +1246,13 @@ def _fallback_execute(entry, leaves, replay, don, donated_dead, sp, prof):
         try:
             with _spans().span("compile", cache="miss", fallback="donation_rejected"):
                 return jitted(*leaves)
-        except Exception:
+        except Exception as e:
+            from ..fault import memory as _mem
+
+            if _mem.is_oom(e):
+                # never eat an exhaustion into an unfused replay — it would
+                # OOM harder on a real device and silently un-fuse on CPU
+                raise
             if sp is not None:
                 sp.set(fallback="eager_replay")
             with _spans().span("execute", fallback="eager_replay"):
@@ -1120,6 +1266,55 @@ def _fallback_execute(entry, leaves, replay, don, donated_dead, sp, prof):
             sp.set(fallback="eager_replay")
         with _spans().span("execute", fallback="eager_replay"):
             return replay(*[jnp.asarray(v) for v in leaves])
+
+
+def _oom_recover(exc, entry, leaves, sp, prof):
+    """Flush-level OOM recovery ladder (fault/memory.py): classify the
+    RESOURCE_EXHAUSTED, free pressure (evict cold executables, refresh the
+    census, shrink serving pools), retry the SAME executable once, and halt
+    with a structured :class:`~paddle_tpu.fault.memory.HbmExhausted` plus a
+    flight post-mortem (census + per-executable attributions + attempts)
+    when the retry fails too. The microbatch-degrade rung lives one layer
+    up, in the engine's train step — the flush has no batch axis to split."""
+    from ..fault import memory as _hbm
+
+    attempts = [{"action": "classify", **_hbm.note_oom("lazy_flush", exc)}]
+    if sp is not None:
+        sp.set(hbm_oom=type(exc).__name__)
+    donated_dead = any(
+        getattr(l, "is_deleted", _false)()
+        for l in leaves
+        if isinstance(l, jax.Array)
+    )
+    if donated_dead:
+        # the failed launch already invalidated donated inputs — nothing to
+        # retry with; the checkpoint/sentinel layer owns recovery from here
+        attempts.append({"action": "retry", "ok": False,
+                         "why": "donated inputs invalidated"})
+        path = _hbm.post_mortem("lazy_flush", attempts, exc)
+        raise _hbm.HbmExhausted("lazy_flush", attempts, path) from exc
+    attempts.append({"action": "free_pressure",
+                     **_hbm.free_pressure("lazy_flush")})
+    try:
+        with _spans().span("execute", retry="hbm_oom"):
+            from .dispatch import _fault_inject as _finj
+
+            if _finj is not None:
+                # consult again: a persistent injected fault (from=) must
+                # defeat the retry the way sustained real pressure would
+                _finj.maybe_hbm_oom("lazy_flush")
+            results = entry[0](*leaves)
+    except Exception as e2:
+        if not _hbm.is_oom(e2):
+            raise
+        attempts.append({"action": "retry", "ok": False})
+        path = _hbm.post_mortem("lazy_flush", attempts, e2)
+        raise _hbm.HbmExhausted("lazy_flush", attempts, path) from e2
+    prof.counter_inc("hbm_oom_recoveries")
+    attempts.append({"action": "retry", "ok": True})
+    if sp is not None:
+        sp.set(hbm_oom_recovered=True)
+    return results
 
 
 def _nan_check(keys, fns, live, results, leaves, descs_all,

@@ -10,11 +10,42 @@ the numbers track the REAL compiler and chip instead of a frozen table.
 from __future__ import annotations
 
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["CostModel"]
+__all__ = ["CostModel", "executable_memory"]
+
+
+def executable_memory(compiled) -> Optional[Dict[str, int]]:
+    """Per-executable memory footprint from XLA's ``memory_analysis()``
+    (the memory-side sibling of the ``cost_analysis()`` wrap above):
+    argument/output/temp/alias bytes plus the derived ``peak_bytes``
+    (argument + output + temp − alias — the aliased share reuses donated
+    input buffers, so it must not count twice). None when the backend
+    doesn't expose the analysis. fault/memory.py keys these dicts like the
+    lazy executable cache and feeds the preflight HBM admission check."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+
+    def g(name):
+        return int(getattr(ma, name, 0) or 0)
+
+    arg = g("argument_size_in_bytes")
+    out = g("output_size_in_bytes")
+    tmp = g("temp_size_in_bytes")
+    alias = g("alias_size_in_bytes")
+    return {
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": tmp,
+        "alias_bytes": alias,
+        "peak_bytes": max(arg + out + tmp - alias, 0),
+    }
 
 
 class CostModel:
@@ -34,15 +65,24 @@ class CostModel:
             fn, args = program._fn, program._example_args
         if fn is None:
             raise ValueError("pass fn=<jittable callable>, args=<inputs>")
+        from ..core import lazy as lazy_mod
+
         jitted = jax.jit(fn)
         out = jitted(*args)
         jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
-        t0 = time.time()
+        # monotonic clock (wall time jumps under NTP/VM migration — the
+        # analysis monotonic-deadline class) and an ATTRIBUTED device wait:
+        # the readback rides lazy.timed_block so it lands as a `block` span
+        # (+ lazy_block_ns) instead of hiding inside a host fetch, with an
+        # unconditional barrier behind it (timed_block is a no-op for
+        # already-ready arrays and when FLAGS_lazy_async is off).
+        t0 = time.monotonic()
         for _ in range(iters):
             out = jitted(*args)
-        leaf = jax.tree_util.tree_leaves(out)[0]
-        np.asarray(leaf).ravel()[:1]  # host fetch = hard sync
-        dt = (time.time() - t0) / iters
+        leaves = jax.tree_util.tree_leaves(out)
+        lazy_mod.timed_block(leaves, "cost_model.profile_measure")
+        jax.block_until_ready(leaves)
+        dt = (time.monotonic() - t0) / iters
         cost = {}
         try:
             analysis = jitted.lower(*args).compile().cost_analysis()
@@ -98,11 +138,13 @@ class CostModel:
                 xt.clear_grad()
                 return g
         out = run()
-        t0 = time.time()
+        t0 = time.monotonic()
         for _ in range(5):
             out = run()
         o = out[0] if isinstance(out, (tuple, list)) else out
+        # Tensor.numpy() routes through the lazy.timed_block funnel, so the
+        # sync that closes the timed region is already an attributed block
         float(np.asarray(o.numpy()).ravel()[0])
-        cost = {"op_time": (time.time() - t0) / 5 * 1e3, "dtype": str(dtype)}
+        cost = {"op_time": (time.monotonic() - t0) / 5 * 1e3, "dtype": str(dtype)}
         self._static_cache[key] = cost
         return cost

@@ -86,6 +86,12 @@ class HybridParallelEngine:
         # stability sentinel (fault/sentinel.py); None keeps the zero-cost
         # path — one attribute check per train_step
         self._sentinel = None
+        # OOM recovery ladder (fault/memory.py): degraded accumulate-step
+        # executables keyed by accumulation factor, and the hbm.oom chaos
+        # consult site name ("engine.step" until a sticky degrade moves the
+        # primary dispatch onto the accum path)
+        self._degraded = {}
+        self._dispatch_op = "engine.step"
 
     def attach_sentinel(self, sentinel) -> None:
         """Hook a :class:`~paddle_tpu.fault.sentinel.StabilitySentinel` into
@@ -136,8 +142,8 @@ class HybridParallelEngine:
         return _sharding(self.mesh, P(*spec))
 
     # -- compiled step -----------------------------------------------------
-    def _build(self):
-        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+    def _make_loss_of(self):
+        model, loss_fn = self.model, self.loss_fn
         params, buffers = self.params, self.buffers
 
         def make_loss_of(batch_arrays, key):
@@ -160,21 +166,20 @@ class HybridParallelEngine:
 
             return loss_of
 
-        def step_fn(param_arrays, opt_state, batch_arrays, lr, key):
-            loss_of = make_loss_of(batch_arrays, key)
-            loss, grads = jax.value_and_grad(loss_of)(list(param_arrays))
-            grads = self._constrain_grads(grads)
-            new_params, new_state = opt._functional_update(
-                param_arrays, grads, opt_state, lr, params=params
-            )
-            return loss, new_params, new_state
+        return make_loss_of
+
+    def _accum_step_fn(self, acc: int):
+        """Gradient accumulation: lax.scan over ``acc`` chunks of the batch
+        (dim0 split), grads averaged into a ZeRO-sharded accumulator, ONE
+        optimizer update (reference GradientMergeOptimizer /
+        HybridParallelEngine grad-accumulate semantics). A factory so the
+        OOM recovery ladder can build the SAME computation at 2×/4× the
+        configured accumulation — a degraded step is bit-identical to a run
+        configured with that accumulation from the start."""
+        make_loss_of = self._make_loss_of()
+        opt, params = self.optimizer, self.params
 
         def accum_step_fn(param_arrays, opt_state, batch_arrays, lr, key):
-            """Gradient accumulation: lax.scan over `grad_accumulate` chunks
-            of the batch (dim0 split), grads averaged into a ZeRO-sharded
-            accumulator, ONE optimizer update (reference GradientMergeOptimizer
-            / HybridParallelEngine grad-accumulate semantics)."""
-            acc = self.grad_accumulate
             chunked = tuple(
                 a.reshape((acc, a.shape[0] // acc) + a.shape[1:]) for a in batch_arrays
             )
@@ -201,6 +206,21 @@ class HybridParallelEngine:
             )
             return loss, new_params, new_state
 
+        return accum_step_fn
+
+    def _build(self):
+        opt, params = self.optimizer, self.params
+        make_loss_of = self._make_loss_of()
+
+        def step_fn(param_arrays, opt_state, batch_arrays, lr, key):
+            loss_of = make_loss_of(batch_arrays, key)
+            loss, grads = jax.value_and_grad(loss_of)(list(param_arrays))
+            grads = self._constrain_grads(grads)
+            new_params, new_state = opt._functional_update(
+                param_arrays, grads, opt_state, lr, params=params
+            )
+            return loss, new_params, new_state
+
         donate = (0, 1) if self.donate else ()
         from .fleet.meta_optimizers.hybrid_parallel_optimizer import (
             ShardedWeightUpdate,
@@ -217,7 +237,11 @@ class HybridParallelEngine:
 
             profiler.counter_inc("wus_enabled", 0)  # ensure key exists
             return
-        fn = accum_step_fn if self.grad_accumulate > 1 else step_fn
+        fn = (
+            self._accum_step_fn(self.grad_accumulate)
+            if self.grad_accumulate > 1
+            else step_fn
+        )
         self._jit = jax.jit(fn, donate_argnums=donate)
 
     def _build_dp_sharded(self, make_loss_of):
@@ -313,15 +337,22 @@ class HybridParallelEngine:
             lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
             key = random_state.next_key()
             return param_arrays, self._dp_state, tuple(batch_arrays), lr, key
+        opt_state = self._replicated_opt_state()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = random_state.next_key()
+        return param_arrays, opt_state, tuple(batch_arrays), lr, key
+
+    def _replicated_opt_state(self):
+        """Optimizer state for the replicated (non-wus) step, accumulators
+        ZeRO-sharded over the sharding axis. Shared by ``_prepare`` and the
+        OOM ladder's degrade rung (a wus engine falling back to the
+        accumulate path mid-step repacks through here)."""
         opt_state = self.optimizer._functional_state(self.params)
-        # ZeRO: shard accumulators over the sharding axis
         opt_state["accums"] = [
             {k: jax.device_put(v, self._opt_sharding(p)) for k, v in st.items()}
             for p, st in zip(self.params, opt_state["accums"])
         ]
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        key = random_state.next_key()
-        return param_arrays, opt_state, tuple(batch_arrays), lr, key
+        return opt_state
 
     @no_grad()
     def lower_text(self, *batch) -> str:
@@ -361,10 +392,10 @@ class HybridParallelEngine:
                 batch_arrays, step=self.optimizer._step_count + 1
             )
         try:
-            loss, new_params, new_state = self._jit(
+            loss, new_params, new_state = self._dispatch(
                 param_arrays, opt_state, batch_arrays, lr, key
             )
-        except Exception:
+        except Exception as e:
             if self._wus is not None and self._dp_state is not None:
                 # the failed launch may have invalidated the donated sharded
                 # state; drop it so the next step repacks from the
@@ -377,7 +408,15 @@ class HybridParallelEngine:
                 )
                 if deleted:
                     self._dp_state = None
-            raise
+            from ..fault import memory as _hbm
+
+            if not _hbm.is_oom(e):
+                raise
+            # RESOURCE_EXHAUSTED on the fused step: free pressure → retry →
+            # degrade through the accumulate scan path → halt (post-mortem)
+            loss, new_params, new_state = self._recover_oom(
+                e, param_arrays, opt_state, batch_arrays, lr, key, sp
+            )
         for p, a in zip(self.params, new_params):
             p._set_data(a)
         if self._wus is not None:
@@ -397,6 +436,129 @@ class HybridParallelEngine:
         self.optimizer._step_count += 1
         self._observe_stability(loss)
         return Tensor(loss)
+
+    def _dispatch(self, *args):
+        """One fused-step launch, with the ``hbm.oom`` chaos point consulted
+        at the dispatch site (the unarmed path is one module-attribute
+        probe — the hook core/dispatch.py already maintains)."""
+        from ..core import dispatch as _dsp
+
+        if _dsp._fault_inject is not None:
+            _dsp._fault_inject.maybe_hbm_oom(
+                self._dispatch_op, step=self.optimizer._step_count + 1
+            )
+        return self._jit(*args)
+
+    def _recover_oom(self, exc, param_arrays, opt_state, batch_arrays, lr,
+                     key, sp):
+        """Engine-level OOM recovery ladder (fault/memory.py), run with the
+        step's ALREADY-PREPARED arguments — the RNG key is reused, not
+        redrawn, so a recovered step consumes exactly the key a healthy (or
+        configured-from-start) run would.
+
+        classify → free pressure → retry once → degrade by re-running the
+        failed step through the grad-accumulate scan path at 2×/4×
+        microbatching (sticky: pressure persists, so the engine STAYS at
+        the working accumulation — every later step is then bit-identical
+        to a run configured with it from the start) → halt with a flight
+        post-mortem carrying the census, the per-executable attributions
+        and every attempt."""
+        from ..fault import memory as _hbm
+        from .. import profiler
+
+        attempts = [{"action": "classify",
+                     **_hbm.note_oom(self._dispatch_op, exc)}]
+
+        def _args_dead():
+            # donate_argnums=(0,1) donates params AND the optimizer/dp
+            # state — a launch that died after invalidating ANY of them has
+            # nothing intact to dispatch with. Re-checked before EVERY rung:
+            # the retry/degrade launches donate too, so a failed rung can
+            # invalidate what the original failure left alive.
+            return any(
+                getattr(a, "is_deleted", lambda: False)()
+                for a in (list(param_arrays) + list(batch_arrays)
+                          + jax.tree_util.tree_leaves(opt_state))
+                if isinstance(a, jax.Array)
+            )
+
+        def _halt(why, cause):
+            attempts.append({"action": "halt", "why": why})
+            path = _hbm.post_mortem("engine.step", attempts, cause)
+            raise _hbm.HbmExhausted("engine.step", attempts, path) from cause
+
+        if _args_dead():
+            # checkpoint/sentinel recovery owns it from here
+            _halt("donated inputs invalidated", exc)
+        attempts.append({"action": "free_pressure",
+                         **_hbm.free_pressure("engine.step")})
+        try:
+            out = self._dispatch(param_arrays, opt_state, batch_arrays, lr, key)
+            profiler.counter_inc("hbm_oom_recoveries")
+            attempts.append({"action": "retry", "ok": True})
+            if sp is not None:
+                sp.set(hbm_oom_recovered="retry")
+            return out
+        except Exception as e2:
+            if not _hbm.is_oom(e2):
+                raise
+            attempts.append({"action": "retry", "ok": False})
+            exc = e2
+        base = self.grad_accumulate
+        for mult in (2, 4):
+            if _args_dead():
+                # the previous (donating) rung died after invalidation —
+                # dispatching the dead arrays would mask the OOM behind a
+                # deleted-array error
+                _halt("donated inputs invalidated by a failed rung", exc)
+            acc = base * mult
+            if any(
+                a.shape[0] % acc
+                for a in batch_arrays
+                if getattr(a, "ndim", 0) >= 1
+            ):
+                attempts.append({"action": f"degrade_x{mult}", "ok": False,
+                                 "why": "batch dim0 not divisible"})
+                continue
+            if self._wus is not None:
+                # the sharded weight update has no accumulate path (PR 3):
+                # sync the shards back and fall to the replicated update —
+                # exactly what a from-start accumulate config builds
+                self.sync_optimizer_state()
+                self._wus = None
+                self._dp_state = None
+                opt_state = self._replicated_opt_state()
+            fn = self._degraded.get(acc)
+            if fn is None:
+                fn = self._degraded[acc] = jax.jit(
+                    self._accum_step_fn(acc),
+                    donate_argnums=(0, 1) if self.donate else (),
+                )
+            try:
+                from ..core import dispatch as _dsp
+
+                if _dsp._fault_inject is not None:
+                    _dsp._fault_inject.maybe_hbm_oom(
+                        "engine.accum", step=self.optimizer._step_count + 1
+                    )
+                out = fn(param_arrays, opt_state, batch_arrays, lr, key)
+            except Exception as e3:
+                if not _hbm.is_oom(e3):
+                    raise
+                attempts.append({"action": f"degrade_x{mult}", "ok": False})
+                exc = e3
+                continue
+            self.grad_accumulate = acc
+            self._jit = fn
+            self._dispatch_op = "engine.accum"
+            profiler.counter_inc("hbm_oom_recoveries")
+            profiler.counter_inc("hbm_degraded_steps")
+            attempts.append({"action": f"degrade_x{mult}", "ok": True})
+            if sp is not None:
+                sp.set(hbm_oom_recovered=f"accum_x{mult}", grad_accumulate=acc)
+            return out
+        path = _hbm.post_mortem("engine.step", attempts, exc)
+        raise _hbm.HbmExhausted("engine.step", attempts, path) from exc
 
     def _observe_stability(self, loss) -> None:
         """Feed the committed step's loss to the attached sentinel (verdicts

@@ -27,6 +27,10 @@ Keys (all optional; values are ints except ``op``):
 * ``times=M`` — fire at most M times total (default: unlimited).
 * ``ms=N``    — ``rank.slow`` payload: straggler delay in milliseconds.
 * ``exit=N``  — ``rank.kill`` payload: exit code (default 137).
+* ``bytes=N`` — ``hbm.pressure`` payload: synthetic live-byte pressure added
+  to the preflight admission estimate while armed.
+* ``blocks=N`` — ``hbm.pressure`` payload: serving KV blocks parked
+  (admission headroom shrink) when the point fires at a scheduler step.
 
 Failure-type points (``store.op``, ``ckpt.write``, ``ckpt.serialize``,
 ``ckpt.ack``, ``ckpt.commit``) raise :class:`InjectedFault` (an ``OSError``,
@@ -47,6 +51,13 @@ consulted at the step boundary via :func:`spike` — they scale the step's
 loss/gradients by ``scale=`` (or poison them non-finite with
 ``nonfinite=1``) and drive the StabilitySentinel skip/rollback suites
 (tests/test_stability_sentinel.py, tests/test_stability_chaos.py).
+Memory-pressure chaos points (``hbm.oom`` / ``hbm.pressure``) drive the OOM
+recovery ladder (fault/memory.py): ``hbm.oom`` synthesizes an XLA
+``RESOURCE_EXHAUSTED`` at a named dispatch site (:func:`maybe_hbm_oom`,
+``op=`` selects the site — ``lazy_flush``, ``engine.step``, ``engine.accum``,
+``serve.step``); ``hbm.pressure`` models sustained pressure (``bytes=``
+inflates the preflight admission estimate while armed, ``blocks=`` parks
+serving KV blocks — tests/test_memory_pressure.py).
 """
 from __future__ import annotations
 
@@ -78,6 +89,12 @@ POINTS: Dict[str, str] = {
     "serve.wedge": "serving engine loop — wedge the scheduler thread (ms=N bounds it)",
     "serve.slow_step": "serving engine loop — per-step straggler delay (ms=N, default 100)",
     "serve.pool_corrupt": "serving engine loop — break PagePool conservation (next free raises)",
+    # -- HBM memory-pressure chaos points (fault/memory.py consumers) ---------
+    "hbm.oom": ("named dispatch sites (op=lazy_flush/engine.step/engine.accum/"
+                "serve.step) — synthesize an XLA RESOURCE_EXHAUSTED there"),
+    "hbm.pressure": ("memory pressure: bytes=N inflates the admission "
+                     "estimate while armed; blocks=N parks serving pool "
+                     "blocks at the scheduler step boundary"),
 }
 
 
@@ -298,6 +315,50 @@ def fired_counts() -> Dict[str, int]:
         return dict(_fired)
 
 
+# -- hbm.* payloads (memory-pressure chaos, fault/memory.py consumers) -------
+def hbm_oom_error(where: str):
+    """Synthesize the error a real device OOM raises: an
+    ``XlaRuntimeError`` carrying the ``RESOURCE_EXHAUSTED`` status text when
+    the binding is constructible (it subclasses RuntimeError), else a plain
+    RuntimeError with the same text — either way ``fault.memory.is_oom``
+    classifies it exactly like the real thing."""
+    msg = (
+        f"RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        f"1073741824 bytes (injected hbm.oom at '{where}')"
+    )
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+
+        return XlaRuntimeError(msg)
+    except Exception:
+        return RuntimeError(msg)
+
+
+def maybe_hbm_oom(where: str, step: Optional[int] = None,
+                  rank: Optional[int] = None) -> None:
+    """Consult ``hbm.oom`` at a named dispatch site (``op=`` selects the
+    site: ``lazy_flush`` / ``engine.step`` / ``engine.accum`` /
+    ``serve.step``; ``at=``/``from=``/``step=``/``times=`` select the firing
+    call). Raises the synthesized RESOURCE_EXHAUSTED *from the dispatch
+    site*, so the OOM recovery ladder handles it exactly like a real one."""
+    if _armed and should_fire("hbm.oom", step=step, op=where, rank=rank):
+        raise hbm_oom_error(where)
+
+
+def pressure_bytes() -> int:
+    """Synthetic live-byte pressure (``hbm.pressure:bytes=N``), PERSISTENT
+    while armed — pressure is a level, not an event, so the admission
+    estimate reads the payload directly instead of consuming a
+    ``should_fire`` count. 0 when unarmed or no ``bytes=`` payload."""
+    if not _armed:
+        return 0
+    cfg = point_cfg("hbm.pressure")
+    b = int(cfg.get("bytes", 0)) if cfg else 0
+    if b:
+        _exercised.add("hbm.pressure")
+    return b
+
+
 # -- tensor.nan payload ------------------------------------------------------
 def poison_first_nan(res) -> bool:
     """Overwrite the first element of the first floating-point output of an
@@ -341,5 +402,6 @@ _arm_from_env()
 __all__ = [
     "POINTS", "InjectedFault", "arm", "disarm", "armed", "should_fire",
     "check", "exercised", "fired_counts", "poison_first_nan", "point_cfg",
-    "chaos", "chaos_drop", "spike",
+    "chaos", "chaos_drop", "spike", "hbm_oom_error", "maybe_hbm_oom",
+    "pressure_bytes",
 ]

@@ -65,7 +65,7 @@ import numpy as np
 
 __all__ = [
     "SIGNALS", "StabilityError", "StabilityVerdict", "QuarantineLog",
-    "StabilitySentinel", "last_signals",
+    "StabilitySentinel", "VerdictBarrier", "last_signals",
 ]
 
 SIGNALS = ("loss", "grad_norm", "nonfinite", "upd_ratio")
@@ -90,12 +90,15 @@ class StabilityError(RuntimeError):
 class StabilityVerdict:
     """One anomaly decision. ``action`` is ``"skip"``/``"rollback"``/
     ``"halt"``; ``late`` means the flagged step's update had already
-    committed when the signal became readable (deferred detection)."""
+    committed when the signal became readable (deferred detection);
+    ``origin_rank`` names the rank whose detector tripped when the verdict
+    arrived through the cross-rank :class:`VerdictBarrier` (None = local)."""
 
     __slots__ = ("action", "step", "pos", "signal", "value", "zscore",
-                 "late", "signals")
+                 "late", "signals", "origin_rank")
 
-    def __init__(self, action, step, pos, signal, value, zscore, late, signals):
+    def __init__(self, action, step, pos, signal, value, zscore, late, signals,
+                 origin_rank=None):
         self.action = action
         self.step = int(step)
         self.pos = pos
@@ -104,12 +107,14 @@ class StabilityVerdict:
         self.zscore = float(zscore)
         self.late = bool(late)
         self.signals = dict(signals)
+        self.origin_rank = origin_rank
 
     def to_dict(self) -> dict:
         return {
             "action": self.action, "step": self.step, "pos": self.pos,
             "signal": self.signal, "value": self.value, "zscore": self.zscore,
             "late": self.late, "signals": self.signals,
+            "origin_rank": self.origin_rank,
         }
 
     def __repr__(self):
@@ -176,6 +181,116 @@ class QuarantineLog:
 
     def __len__(self):
         return len(self._entries)
+
+
+_SEVERITY = {"skip": 1, "rollback": 2, "halt": 3}
+
+
+class VerdictBarrier:
+    """Store-mediated cross-rank verdict agreement (the PR 13 follow-up to
+    deterministic world-wide trips).
+
+    With all-reduced gradients a spike trips every rank's detector in the
+    same step, so coordinated rollback falls out of determinism. A
+    rank-LOCAL anomaly — host memory corrupting one rank's batch, a bad
+    DataLoader worker — trips ONE detector, and without coordination that
+    rank rolls back alone while its peers march on: the world diverges.
+    This barrier reuses :class:`~paddle_tpu.distributed.coord.CommitBarrier`
+    rounds so every rank leaves each step boundary with the SAME verdict:
+
+    1. each rank publishes its local verdict (if any) for the round, then
+       acks the round's two-phase barrier — after rank 0's commit record no
+       rank can still be writing;
+    2. every rank reads every peer's verdict and adopts the most severe one
+       posted anywhere (ties broken by z-score, then rank);
+    3. ranks whose own detector stayed silent fold the adopted verdict into
+       their sentinel (:meth:`StabilitySentinel.adopt`): same quarantine
+       entry, same ladder rung — the subsequent ``rollback`` then resolves
+       one anchor world-wide through the existing store-mediated resume
+       agreement.
+
+    ``exchange`` must be called once per step attempt on EVERY rank, in
+    lockstep (rounds are monotonic and never reused, so no ``reset`` litter
+    race exists). A barrier timeout degrades to the local verdict — a dead
+    peer is the watchdog's jurisdiction, and stalling recovery on it would
+    hang the healthy ranks.
+    """
+
+    def __init__(self, store, world_size: int, rank: int, sentinel=None,
+                 prefix: str = "stability", timeout_s: float = 60.0):
+        from ..distributed.coord import CommitBarrier
+
+        self.store = store
+        self.world_size = int(world_size)
+        self.rank = int(rank)
+        self.prefix = prefix
+        self.timeout_s = float(timeout_s)
+        self._bar = CommitBarrier(store, world_size, rank,
+                                  prefix=f"{prefix}/bar")
+        self._sentinel = weakref.ref(sentinel) if sentinel is not None else None
+        self._round = 0
+
+    def exchange(self, verdict: Optional[StabilityVerdict]
+                 ) -> Optional[StabilityVerdict]:
+        """One coordination round: publish this rank's ``verdict`` (or
+        None), synchronize, return the world-agreed verdict (or None)."""
+        from .. import profiler as _prof
+
+        tag = self._round
+        self._round += 1
+        if verdict is not None:
+            self.store.set(
+                f"{self.prefix}/v/{tag}/r{self.rank}",
+                json.dumps(verdict.to_dict()),
+            )
+        try:
+            self._bar.ack(tag)
+            self._bar.commit(tag, self.timeout_s)
+        except Exception:
+            _prof.counter_inc("stability_barrier_timeouts")
+            return verdict
+        # bounded store footprint: round N's commit proves every rank left
+        # round N-1 long ago, so its barrier keys and this rank's verdict
+        # key can go — one live round instead of one key pair per step
+        if tag:
+            self._bar.reset(tag - 1)
+            self.store.delete_key(f"{self.prefix}/v/{tag - 1}/r{self.rank}")
+        # most severe verdict posted anywhere, ties broken by z-score then
+        # LOWEST rank — the full key is identical on every rank, so equal
+        # (severity, z) verdicts (e.g. two rank-local nonfinite trips, both
+        # z=inf) still resolve to ONE world-wide choice
+        cands = [(self.rank, verdict)] if verdict is not None else []
+        for r in range(self.world_size):
+            if r == self.rank:
+                continue
+            raw = self.store.get(f"{self.prefix}/v/{tag}/r{r}")
+            if not raw:
+                continue
+            d = json.loads(raw)
+            cands.append((r, StabilityVerdict(
+                d["action"], d["step"],
+                tuple(d["pos"]) if d.get("pos") else None,
+                d["signal"], d["value"], d["zscore"], True,
+                d.get("signals") or {}, origin_rank=r,
+            )))
+        if not cands:
+            return None
+        _, best = max(
+            cands,
+            key=lambda rv: (_SEVERITY.get(rv[1].action, 0), rv[1].zscore, -rv[0]),
+        )
+        if best.origin_rank is not None and verdict is None:
+            # a remote detector tripped and the LOCAL one stayed silent:
+            # fold the verdict into the local sentinel so quarantine +
+            # ladder state stay world-consistent. A rank whose own verdict
+            # was merely OUTRANKED already consumed its rung (and
+            # quarantined the same world-shared batch) in _judge — adopting
+            # on top would double-count the incident budget and desync the
+            # ladders across ranks.
+            s = self._sentinel() if self._sentinel is not None else None
+            if s is not None:
+                s.adopt(best)
+        return best
 
 
 # -- device-side signal pack --------------------------------------------------
@@ -642,6 +757,34 @@ class StabilitySentinel:
             extra={"verdict": verdict.to_dict(), "anchor_step": anchor_step},
         )
         return anchor_step
+
+    def adopt(self, verdict: StabilityVerdict) -> StabilityVerdict:
+        """Fold a verdict ANOTHER rank reached (:class:`VerdictBarrier`)
+        into this sentinel: quarantine the condemned batch locally (loader
+        positions are world-shared in lockstep data-parallel loops) and
+        consume the same ladder rung, so the coordinated replay skips the
+        batch on every rank and the incident budget stays consistent with
+        the rank that actually tripped."""
+        from .. import profiler as _prof
+
+        _prof.counter_inc("stability_coordinated_trips")
+        self._clean_streak = 0
+        if verdict.action == "rollback":
+            self._rollbacks_used += 1
+        elif verdict.action == "skip":
+            self._skips_used += 1
+        if verdict.action in ("skip", "rollback"):
+            self.quarantine.add(
+                verdict.step, pos=verdict.pos, signals=verdict.signals,
+                action=verdict.action,
+            )
+        with self._lock:
+            self._history.append({
+                "step": verdict.step, **verdict.signals,
+                "anomaly": verdict.signal,
+                "adopted_from_rank": verdict.origin_rank,
+            })
+        return verdict
 
     def halt(self, verdict: StabilityVerdict, reason: str = "") -> None:
         """Terminal rung: flight post-mortem naming the tripping signal,

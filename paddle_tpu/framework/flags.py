@@ -132,6 +132,19 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_stability_anchor_interval": 25,
     "FLAGS_stability_ckpt_dir": "",
     "FLAGS_stability_quarantine_dir": "",
+    # HBM exhaustion resilience (fault/memory.py). FLAGS_hbm_admission gates
+    # the preflight memory-admission check on the lazy flush: "off" (default;
+    # the whole disabled path is one flag probe per flush), "warn" (predict
+    # and attach the estimate to the compile/flush spans, warn once per
+    # executable when over budget, dispatch anyway), "enforce" (raise a
+    # structured HbmBudgetExceeded BEFORE the dispatch touches the device).
+    # FLAGS_hbm_budget_bytes overrides the device budget (0 = resolve from
+    # the backend's reported capacity minus FLAGS_hbm_reserve_bytes; on
+    # backends that report no capacity — CPU — 0 means no budget, so
+    # admission only predicts/attributes and never rejects).
+    "FLAGS_hbm_admission": "off",
+    "FLAGS_hbm_budget_bytes": 0,
+    "FLAGS_hbm_reserve_bytes": 256 * 1024 * 1024,
     # JAX persistent compilation cache (warm executable starts across
     # processes). Dir defaults to ~/.cache/paddle_tpu/xla when unset.
     "FLAGS_xla_persistent_cache": True,

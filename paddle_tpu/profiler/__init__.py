@@ -139,6 +139,19 @@ def counters() -> Dict[str, int]:
     serve_relayed, so lifecycle counters stay per-logical-outcome), and
     ``serve_pool_damaged`` (serve.pool_corrupt chaos firings).
 
+    HBM exhaustion resilience (fault/memory.py): ``hbm_admission_checks`` /
+    ``hbm_admission_rejects`` (preflight admission decisions under
+    ``FLAGS_hbm_admission``), ``hbm_oom_trips`` (classified
+    RESOURCE_EXHAUSTED events, wherever they fired), ``hbm_oom_recoveries``
+    (ladder rungs that brought the step/stream back — flush retry, engine
+    microbatch degrade), ``hbm_degraded_steps`` (engine steps re-run
+    through the grad-accumulate scan path), ``hbm_cache_evicted`` (cold
+    lazy executables dropped by free_pressure), ``serve_pool_shrunk`` /
+    ``serve_pages_parked`` / ``serve_pages_unparked`` (serving KV-block
+    admission-headroom shrink under pressure), and
+    ``stability_coordinated_trips`` / ``stability_barrier_timeouts`` (the
+    sentinel's cross-rank VerdictBarrier adoptions and degraded rounds).
+
     Telemetry: ``flight_dumps`` (flight-recorder post-mortems written by
     this process).
 

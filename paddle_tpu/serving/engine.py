@@ -46,12 +46,24 @@ programs in ``models/generation.py``:
   and running work, then stops — the graceful-rolling-restart half of the
   supervisor's crash/wedge recovery (serving/supervisor.py).
 
+* **HBM pressure** (fault/memory.py) — a ``RESOURCE_EXHAUSTED`` inside a
+  serving step is classified and answered by PARKING free KV blocks
+  (``PagePool.park`` — admission headroom shrinks, continuous batching
+  backs off to a smaller resident working set) and retrying on the next
+  scheduler iteration: the PR 11 invariant "pool exhaustion is never a
+  crash" extends to HBM exhaustion — streams complete late under
+  backpressure. Training-side pressure reaches live engines through
+  ``request_pool_shrink`` (the registered ``free_pressure`` handler), and a
+  shrink-proof OOM streak falls through to the crash-containment path so
+  clients are never hung.
+
 Every scheduler action is a profiler span (``admit``/``schedule``/
 ``prefill``/``decode_step``/``page_alloc``/``evict``) with ``serve_*``
 counters, and the engine registers a flight-recorder context provider so
 crash dumps carry the in-flight request table. Chaos points ``serve.crash``
-/ ``serve.wedge`` / ``serve.slow_step`` / ``serve.pool_corrupt``
-(fault/inject.py) fire at the scheduler step boundary when armed.
+/ ``serve.wedge`` / ``serve.slow_step`` / ``serve.pool_corrupt`` /
+``hbm.oom`` / ``hbm.pressure`` (fault/inject.py) fire at the scheduler
+step boundary when armed.
 """
 from __future__ import annotations
 
@@ -379,6 +391,16 @@ class Engine:
         # so a cold start is not misread as a wedge — a thread genuinely
         # wedged inside a compile is still caught, just later
         self._compiling = False
+        # HBM pressure (fault/memory.py): cross-thread shrink request the
+        # scheduler applies at its next step boundary (engine-thread-only
+        # pool ownership holds; -1 = default fraction; guarded by _cv), the
+        # consecutive OOM-step streak that bounds in-place recovery before
+        # the crash containment path takes over, and the clean-step
+        # countdown that gradually returns parked blocks once pressure
+        # clears (a transient OOM must not ratchet capacity down forever)
+        self._shrink_req = 0  # guarded_by: _cv
+        self._oom_streak = 0
+        self._unpark_countdown = 0
 
         # cross-thread state
         self._lock = threading.Lock()
@@ -417,6 +439,14 @@ class Engine:
                 else {"closed": True}
             ),
         )
+        # the serving rung of fault/memory.free_pressure: a training-side
+        # OOM can ask every live engine to give HBM back (pool headroom
+        # shrink → admission backpressure). Weakly bound — a collected
+        # engine drops out of the registry by itself.
+        from ..fault import memory as _fmem
+
+        _fmem.register_pressure_handler(
+            self._provider, lambda eng: eng.request_pool_shrink(), owner=self)
         self._thread = threading.Thread(
             target=_engine_loop, args=(wr,), daemon=True, name=self._provider)
         self._thread.start()
@@ -509,6 +539,7 @@ class Engine:
             "pages_total": self._pool.num_blocks - 1,
             "pages_used": self._pool.used_blocks,
             "pages_free": self._pool.free_blocks,
+            "pages_parked": self._pool.parked_blocks,
             "compiles": len(self._fns),
             "decode_steps": self._step_i,
         }
@@ -579,10 +610,13 @@ class Engine:
         # when the loop's deref holds the last reference); same for this
         # engine's watchdog unit record — stale units must not outlive it
         flight.remove_context_provider(self._provider)
+        from ..fault import memory as _fmem
+
+        _fmem.unregister_pressure_handler(self._provider)
         if self._watchdog is not None:
             try:
                 self._watchdog.remove_unit(self._provider)
-            except Exception:
+            except Exception:  # lint: ok(oom-handler) — store bookkeeping, nothing dispatches in this try
                 pass
         if not on_sched_thread:
             # drain path: the drain join above may have consumed the whole
@@ -614,7 +648,7 @@ class Engine:
         for req in waiting + [s.req for s in seqs]:
             try:
                 self._finish_request(req, error=ServeError(str(err)))
-            except Exception:
+            except Exception:  # lint: ok(oom-handler) — handle-state sweep, nothing dispatches in this try
                 pass
 
     def __enter__(self):
@@ -627,7 +661,7 @@ class Engine:
     def __del__(self):
         try:
             self.close(timeout=2.0)
-        except Exception:
+        except Exception:  # lint: ok(oom-handler) — teardown guard, nothing dispatches in this try
             pass
 
     # ------------------------------------------------------- engine thread
@@ -654,8 +688,25 @@ class Engine:
         return False
 
     def _step(self):
-        if _inject._armed:
-            self._chaos_step()
+        self._apply_pool_shrink()
+        try:
+            if _inject._armed:
+                self._chaos_step()
+            self._step_impl()
+        except Exception as e:
+            from ..fault import memory as _mem
+
+            if not _mem.is_oom(e):
+                raise
+            # RESOURCE_EXHAUSTED inside a serving step: give HBM back (pool
+            # headroom shrink → admission backpressure) and let the next
+            # scheduler iteration retry — streams complete late, never crash.
+            # A streak that shrinking cannot break falls through to the
+            # crash-containment path (handles failed / supervisor restart),
+            # so sustained exhaustion can never hang clients either.
+            self._on_oom(e)
+
+    def _step_impl(self):
         with span("schedule", step=self._step_i,
                   running=len(self._running)) as sp:
             self._drain_cancels()
@@ -672,6 +723,83 @@ class Engine:
             if self._running:
                 self._decode()
             sp.set(running_after=len(self._running))
+            self._oom_streak = 0
+            self._maybe_unpark()
+
+    # clean scheduler steps (work done, no OOM) before parked blocks start
+    # returning to circulation; halved-back gradually so a recurrence
+    # re-parks quickly (class attr so tests can compress the window)
+    _UNPARK_AFTER = 64
+
+    def _maybe_unpark(self):
+        """Pressure decay: after a clean-step window, return parked blocks
+        to the free list half at a time — a transient OOM must not leave the
+        pool permanently shrunk."""
+        if not self._pool.parked_blocks:
+            return
+        if self._unpark_countdown > 0:
+            self._unpark_countdown -= 1
+            return
+        # (PagePool.unpark counts serve_pages_unparked — the one decay
+        # counter; no engine-level duplicate)
+        self._pool.unpark(max(self._pool.parked_blocks // 2, 1))
+        self._unpark_countdown = self._UNPARK_AFTER
+
+    def _apply_pool_shrink(self):
+        """Apply a cross-thread shrink request (engine thread only — the
+        scheduler is the pool's single owner; the request word is read and
+        cleared under _cv so a writer landing mid-apply is never lost)."""
+        with self._cv:
+            req = self._shrink_req
+            self._shrink_req = 0
+        if not req:
+            return
+        n = req if req > 0 else max(self._pool.free_blocks // 4, 1)
+        parked = self._pool.park(n)
+        if parked:
+            counter_inc("serve_pool_shrunk", parked)
+            self._unpark_countdown = self._UNPARK_AFTER
+
+    def request_pool_shrink(self, blocks: Optional[int] = None) -> dict:
+        """(any thread) Ask the scheduler to park KV blocks at its next step
+        boundary — admission headroom shrinks, continuous batching backs
+        off, nothing crashes. ``blocks=None`` parks a quarter of the free
+        list. The serving callback fault/memory.free_pressure runs."""
+        with self._cv:
+            self._shrink_req = int(blocks) if blocks else -1
+            self._cv.notify()
+        return {"requested_blocks": blocks or "free/4",
+                "pages_free": self._pool.free_blocks,
+                "pages_parked": self._pool.parked_blocks}
+
+    def _on_oom(self, exc: BaseException) -> None:
+        from ..fault import memory as _mem
+
+        self._oom_streak += 1
+        if self._oom_streak > 8:
+            # shrinking is not helping — contain, don't loop (the engine
+            # loop's containment handler notes THIS exhaustion, so it is
+            # not recorded twice)
+            raise exc
+        _mem.note_oom("serve.step", exc)
+        # a mid-prefill OOM strands sequences in _admitting (blocks granted,
+        # KV never written): free the grant and route them through the
+        # preemption/resume path — they re-prefill from their accumulated
+        # tokens once headroom allows, exactly like an evicted peer
+        for seq in self._admitting:
+            try:
+                if seq.blocks:
+                    self._pool.free(seq.blocks)
+            except Exception:  # lint: ok(oom-handler) — pool itself may be what broke; the sweep must reach every seq
+                pass
+            seq.blocks = []
+            if not seq.req.done.is_set():
+                self._resume.append(seq)
+        self._admitting = []
+        parked = self._pool.park(max(self._pool.free_blocks // 4, 1))
+        if parked:
+            counter_inc("serve_pool_shrunk", parked)
+        self._unpark_countdown = self._UNPARK_AFTER
 
     def _chaos_step(self):
         """``serve.*`` chaos points, consulted once per scheduler step while
@@ -693,6 +821,13 @@ class Engine:
                 _inject._hang("serve.wedge")
         if _inject.should_fire("serve.crash", step=step):
             raise ServeError(f"injected serve.crash at engine step {step}")
+        if _inject.should_fire("hbm.pressure", step=step):
+            blocks = _inject.point_cfg("hbm.pressure").get("blocks")
+            if blocks:
+                self.request_pool_shrink(blocks)
+        # synthesized RESOURCE_EXHAUSTED at the serving dispatch site —
+        # raises into _step's OOM handler (shrink + backpressure, no crash)
+        _inject.maybe_hbm_oom("serve.step", step=step)
 
     def _shed_sweep(self):
         """Step-boundary deadline enforcement. Runs only once a deadline'd
@@ -1039,12 +1174,12 @@ class Engine:
             try:
                 if seq.blocks:
                     self._pool.free(seq.blocks)
-            except Exception:
+            except Exception:  # lint: ok(oom-handler) — corrupt-pool containment sweep, crash already classified in _step
                 pass
             seq.blocks = []
             try:
                 self._finish_request(seq.req, error=ServeError(str(err)))
-            except Exception:
+            except Exception:  # lint: ok(oom-handler) — handle-state sweep, nothing dispatches in this try
                 pass
         self._running, self._resume, self._admitting = [], [], []
 
@@ -1091,7 +1226,8 @@ class Engine:
             "queue_depth": depth,
             "step": self._step_i,
             "pages": {"used": self._pool.used_blocks,
-                      "free": self._pool.free_blocks},
+                      "free": self._pool.free_blocks,
+                      "parked": self._pool.parked_blocks},
             "running": [
                 {"id": s.req.id, "prompt_len": s.prompt_len,
                  "generated": s.generated, "pos": s.pos,
@@ -1137,7 +1273,12 @@ def _engine_loop(wr):
             # fail loudly into every pending handle rather than leave
             # clients blocked on events that will never fire — and nothing
             # (not even a failing post-mortem) may stand between the crash
-            # and that sweep
+            # and that sweep. An exhaustion that defeated the in-step shrink
+            # ladder lands here too — classified, then contained.
+            from ..fault import memory as _mem
+
+            if _mem.is_oom(e):
+                _mem.note_oom("serve.loop", e)
             eng._broken = e
             try:
                 counter_inc("serve_engine_errors")

@@ -16,6 +16,13 @@ allocating more than is free returns ``None`` (the scheduler turns that into
 queue backpressure or preemption, never a crash), freeing an unowned id
 raises (double-free), and ``check()`` asserts conservation. Engine-thread
 only — the scheduler is the single owner, so no lock is needed here.
+
+Under HBM pressure (fault/memory.py recovery ladder) the scheduler PARKS
+blocks: :meth:`park` moves free blocks to a reserved set that ``alloc``
+cannot see, shrinking admission headroom so continuous batching backs off
+to a smaller resident working set — backpressure, never a crash. ``check``
+counts parked blocks in the conservation invariant; :meth:`unpark` gives
+them back once pressure clears.
 """
 from __future__ import annotations
 
@@ -36,6 +43,9 @@ class PagePool:
         # LIFO free list: recently-freed blocks are re-used first (warm)
         self._free: List[int] = list(range(self.num_blocks - 1, TRASH_BLOCK, -1))
         self._owned = set()
+        # blocks withdrawn from circulation under memory pressure (park()):
+        # invisible to alloc, still conserved by check()
+        self._parked: List[int] = []
 
     @property
     def free_blocks(self) -> int:
@@ -67,6 +77,36 @@ class PagePool:
             self._free.append(b)
         counter_inc("serve_pages_freed", len(ids))
 
+    @property
+    def parked_blocks(self) -> int:
+        return len(self._parked)
+
+    def park(self, n: int) -> int:
+        """Withdraw up to ``n`` FREE blocks from circulation (HBM-pressure
+        admission-headroom shrink): parked blocks are invisible to ``alloc``
+        so the scheduler's backpressure engages at a smaller resident
+        working set. Running sequences keep what they own — only future
+        growth is throttled. Returns how many were actually parked (never
+        drains the free list completely: one grow-block of headroom stays,
+        so a lone running sequence can still finish)."""
+        if n < 0:
+            raise ValueError(f"park({n})")
+        take = max(min(int(n), len(self._free) - 1), 0)
+        for _ in range(take):
+            self._parked.append(self._free.pop())
+        if take:
+            counter_inc("serve_pages_parked", take)
+        return take
+
+    def unpark(self, n: Optional[int] = None) -> int:
+        """Return parked blocks to the free list (pressure cleared)."""
+        take = len(self._parked) if n is None else min(int(n), len(self._parked))
+        for _ in range(take):
+            self._free.append(self._parked.pop())
+        if take:
+            counter_inc("serve_pages_unparked", take)
+        return take
+
     def damage(self) -> None:
         """Chaos-only (``serve.pool_corrupt`` injection point): deliberately
         break conservation so the next ``free()`` of the damaged block (or
@@ -81,13 +121,17 @@ class PagePool:
 
     def check(self) -> None:
         """Conservation invariant: every non-trash block is exactly one of
-        free or owned."""
-        if len(self._free) + len(self._owned) != self.num_blocks - 1:
+        free, owned, or parked."""
+        if len(self._free) + len(self._owned) + len(self._parked) \
+                != self.num_blocks - 1:
             raise RuntimeError(
                 f"PagePool leak: {len(self._free)} free + "
-                f"{len(self._owned)} owned != {self.num_blocks - 1}"
+                f"{len(self._owned)} owned + {len(self._parked)} parked "
+                f"!= {self.num_blocks - 1}"
             )
-        if self._owned & set(self._free):
-            raise RuntimeError("PagePool: block both free and owned")
-        if TRASH_BLOCK in self._owned or TRASH_BLOCK in self._free:
+        circulating = set(self._free) | set(self._parked)
+        if self._owned & circulating or len(circulating) != (
+                len(self._free) + len(self._parked)):
+            raise RuntimeError("PagePool: block in two states at once")
+        if TRASH_BLOCK in self._owned or TRASH_BLOCK in circulating:
             raise RuntimeError("PagePool: trash block entered circulation")

@@ -143,6 +143,11 @@ def _monitor_loop(wr) -> None:
             try:
                 sup._recover(eng, kind, err)
             except Exception as e:  # a failed recovery breaks the supervisor
+                from ..fault import memory as _mem
+
+                if _mem.is_oom(e):
+                    # the respawn's pool allocation can itself exhaust HBM
+                    _mem.note_oom("serve.respawn", e)
                 with sup._lock:
                     if sup._broken is None:
                         sup._broken = e
@@ -211,7 +216,7 @@ class ServingSupervisor:
             from ..distributed import watchdog as _wd
 
             eng._watchdog = _wd
-        except Exception:
+        except Exception:  # lint: ok(oom-handler) — watchdog import guard, nothing dispatches in this try
             eng._watchdog = None
         return eng
 
@@ -309,7 +314,7 @@ class ServingSupervisor:
     def __del__(self):
         try:
             self.close(timeout=2.0)
-        except Exception:
+        except Exception:  # lint: ok(oom-handler) — teardown guard, nothing dispatches in this try
             pass
 
     # ------------------------------------------------------------- recovery
@@ -335,7 +340,7 @@ class ServingSupervisor:
                 extra={"reason": str(err), "restarts": restarts,
                        "exhausted": exhausted},
             )
-        except Exception:
+        except Exception:  # lint: ok(oom-handler) — flight-dump guard, nothing dispatches in this try
             pass
         # quarantine: a late-resuming BOUNDED wedge must exit at its next
         # loop check instead of double-driving a restarted request's stream
@@ -346,7 +351,7 @@ class ServingSupervisor:
         if old._watchdog is not None:
             try:  # the dead engine's progress-table unit goes with it
                 old._watchdog.remove_unit(old._provider)
-            except Exception:
+            except Exception:  # lint: ok(oom-handler) — store bookkeeping, nothing dispatches in this try
                 pass
         work = self._harvest(old, kind, err)
         if exhausted:
@@ -486,7 +491,7 @@ class ServingSupervisor:
                            stream=req.stream_q is not None,
                            deadline_s=dl, priority=req.priority,
                            _shed_exempt=True)
-        except Exception as e:
+        except Exception as e:  # lint: ok(oom-handler) — submit() only enqueues; prefill dispatch happens on the engine thread
             _finish(req, error=e if isinstance(e, ServeError)
                     else ServeError(f"requeue after restart failed: {e!r}"))
             return None

@@ -237,6 +237,85 @@ class TestBareExceptRule:
         assert _lint(src, "ops/foo.py") == []
 
 
+class TestOomHandlerRule:
+    def test_broad_except_in_dispatch_file_flagged(self):
+        src = """
+        def launch(jitted, leaves):
+            try:
+                return jitted(*leaves)
+            except Exception:
+                return None
+        """
+        findings = _lint(src, "core/lazy.py")
+        assert [f.rule for f in findings] == ["oom-handler"]
+
+    def test_classifier_routing_passes(self):
+        src = """
+        def launch(jitted, leaves):
+            try:
+                return jitted(*leaves)
+            except Exception as e:
+                from ..fault import memory as _mem
+                if _mem.is_oom(e):
+                    return _recover(e)
+                return None
+        """
+        assert _lint(src, "serving/engine.py") == []
+
+    def test_bare_reraise_passes(self):
+        src = """
+        def launch(jitted, leaves):
+            try:
+                return jitted(*leaves)
+            except RuntimeError:
+                cleanup()
+                raise
+        """
+        assert _lint(src, "distributed/engine.py") == []
+
+    def test_narrow_type_not_flagged(self):
+        src = """
+        def launch(path):
+            try:
+                return open(path, "rb").read()
+            except OSError:
+                return None
+        """
+        assert _lint(src, "core/dispatch.py") == []
+
+    def test_tuple_with_catchable_type_flagged(self):
+        src = """
+        def launch(jitted, leaves):
+            try:
+                return jitted(*leaves)
+            except (ValueError, RuntimeError):
+                return None
+        """
+        findings = _lint(src, "serving/supervisor.py")
+        assert [f.rule for f in findings] == ["oom-handler"]
+
+    def test_outside_dispatch_layer_not_checked(self):
+        src = """
+        def f(x):
+            try:
+                return g(x)
+            except Exception:
+                return None
+        """
+        assert _lint(src, "core/tensor.py") == []
+        assert _lint(src, "serving/pool.py") == []
+
+    def test_inline_suppression(self):
+        src = """
+        def launch(jitted, leaves):
+            try:
+                return jitted(*leaves)
+            except Exception:  # lint: ok(oom-handler)
+                return None
+        """
+        assert _lint(src, "core/lazy.py") == []
+
+
 class TestFlagRegistryRule:
     def test_unregistered_flag_reported(self, tmp_path):
         pkg = tmp_path / "pkg"

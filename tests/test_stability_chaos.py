@@ -141,9 +141,115 @@ sys.exit(0)
 """
 
 
-def _launch_world(run_dir, inject_spec=None, pre_q=()):
+_COORD_WORKER = r"""
+import json, os, sys
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+import paddle_tpu
+from paddle_tpu.distributed import watchdog
+from paddle_tpu.distributed.checkpoint import CoordinatedCheckpoint
+from paddle_tpu.fault import inject
+from paddle_tpu.fault.sentinel import StabilitySentinel, VerdictBarrier
+from paddle_tpu.core import lazy
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+run_dir = os.environ["CHAOS_RUN_DIR"]
+total_steps = int(os.environ["CHAOS_TOTAL_STEPS"])
+pre_q = [int(s) for s in os.environ.get("CHAOS_PRE_Q", "").split(",") if s]
+
+watchdog.configure()
+store = watchdog._cfg["store"]
+assert store is not None, "stability chaos worker needs PADDLE_TPU_STORE_DIR"
+
+
+def data_for(step):
+    # WORLD-SHARED batches (lockstep DP semantics): the rank-LOCAL anomaly
+    # is the spike — host memory corruption on one rank — not the data
+    rng = np.random.RandomState(9000 + step)
+    return rng.randn(8, 4).astype(np.float32), rng.randn(8, 1).astype(np.float32)
+
+
+w = paddle_tpu.to_tensor(np.full((4, 1), 0.5, np.float32))
+w.stop_gradient = False
+opt = paddle_tpu.optimizer.Adam(learning_rate=0.05, parameters=[w])
+state = {"w": w, "opt": opt}
+
+cc = CoordinatedCheckpoint(
+    os.path.join(run_dir, "ckpt"), world_size=world, rank=rank, store=store,
+    interval_steps=1, commit_timeout_s=30.0,
+)
+sent = StabilitySentinel(window=32, warmup=3, zmax=50, max_skips=2,
+                         max_rollbacks=2, cooldown=2, anchor=cc)
+# the verdict barrier: every rank leaves each step boundary with the SAME
+# verdict, even when only ONE rank's detector tripped
+vb = VerdictBarrier(store, world, rank, sentinel=sent, timeout_s=60.0)
+for s in pre_q:
+    sent.quarantine.add(-1, pos=(0, s), action="skip")
+
+records = {}
+rollbacks = []
+adopted = []
+step = 0
+while step < total_steps:
+    if sent.is_quarantined(pos=(0, step)):
+        step += 1
+        continue
+    x, y = data_for(step)
+    xt, yt = paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(y)
+    loss = ((paddle_tpu.matmul(xt, w) - yt) ** 2).mean()
+    s = inject.spike("loss.spike", step=step, rank=rank)
+    if s is not None:
+        loss = loss * s
+    loss.backward()
+    v_local = sent.observe(step, loss=loss, grads=[w.grad], params=[w],
+                           lr=opt.get_lr(), pos=(0, step))
+    # the exchange doubles as the per-step lockstep barrier
+    v = vb.exchange(v_local)
+    if v is not None:
+        opt.clear_grad()
+        if v.origin_rank is not None:
+            adopted.append([v.step, v.origin_rank])
+        if v.action == "skip" and v.step == step:
+            step += 1
+            continue
+        if v.action == "rollback":
+            a = sent.rollback(v, state)
+            rollbacks.append([v.step, a])
+            step = a + 1
+            continue
+        sent.halt(v)
+    opt.step()
+    opt.clear_grad()
+    records[step] = {
+        "loss_hex": float(loss.item()).hex(),
+        "w_hex": [float(x_) for x_ in np.asarray(lazy.concrete(w._data)).ravel()],
+    }
+    sent.maybe_anchor(step, state)
+    step += 1
+
+sent.poll()
+sent.close()
+for e in sent.quarantine.entries():
+    records.pop(e["step"], None)
+out = {
+    "records": {str(k): v for k, v in sorted(records.items())},
+    "rollbacks": rollbacks,
+    "adopted": adopted,
+    "quarantined": sorted({e["step"] for e in sent.quarantine.entries()}),
+}
+with open(os.path.join(run_dir, f"out_rank{rank}.json"), "w") as f:
+    json.dump(out, f)
+sys.exit(0)
+"""
+
+
+def _launch_world(run_dir, inject_spec=None, pre_q=(), worker_src=_WORKER):
     script = run_dir / "worker.py"
-    script.write_text(_WORKER)
+    script.write_text(worker_src)
     procs = []
     for rank in range(WORLD):
         env = dict(os.environ)
@@ -222,4 +328,51 @@ def test_repeated_spikes_recovered_bit_exact_2proc(tmp_path):
         for k in ref["records"]:
             assert got["records"][k] == ref["records"][k], (
                 f"rank {rank} step {k}: post-recovery divergence"
+            )
+
+
+RANK_SPIKE_STEP = 4
+
+
+def test_rank_local_spike_triggers_coordinated_rollback(tmp_path):
+    """PR 13 follow-up pin: a spike firing on ONE rank only
+    (``loss.spike:rank=1``) — the host-memory-corruption shape — must roll
+    back BOTH ranks through the store-mediated VerdictBarrier: rank 0's
+    detector never trips, it ADOPTS rank 1's verdict, both quarantine the
+    batch and resolve one anchor via the coordinated resume agreement, and
+    the surviving timeline is bit-exact against a world that excluded the
+    batch up front."""
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    procs = _launch_world(ref_dir, pre_q=(RANK_SPIKE_STEP,),
+                          worker_src=_COORD_WORKER)
+    codes = _wait_world(procs)
+    assert codes == [0] * WORLD, [p.stdout.read().decode()[-800:] for p in procs]
+
+    run_dir = tmp_path / "chaos"
+    run_dir.mkdir()
+    procs = _launch_world(
+        run_dir,
+        inject_spec=f"loss.spike:rank=1,step={RANK_SPIKE_STEP},scale=1000000",
+        worker_src=_COORD_WORKER,
+    )
+    codes = _wait_world(procs)
+    assert codes == [0] * WORLD, [p.stdout.read().decode()[-800:] for p in procs]
+
+    out = {rank: _read_out(run_dir, rank) for rank in range(WORLD)}
+    # rank 1 tripped locally; rank 0 adopted the verdict across the store
+    assert out[0]["adopted"] == [[RANK_SPIKE_STEP, 1]]
+    assert out[1]["adopted"] == []
+    for rank in range(WORLD):
+        ref = _read_out(ref_dir, rank)
+        got = out[rank]
+        assert len(got["rollbacks"]) == 1
+        bad, anchor = got["rollbacks"][0]
+        assert bad == RANK_SPIKE_STEP and anchor < bad
+        assert got["quarantined"] == [RANK_SPIKE_STEP]
+        assert not ref["rollbacks"] and not ref["adopted"]
+        assert set(got["records"]) == set(ref["records"])
+        for k in ref["records"]:
+            assert got["records"][k] == ref["records"][k], (
+                f"rank {rank} step {k}: coordinated-rollback divergence"
             )
