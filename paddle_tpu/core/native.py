@@ -24,6 +24,11 @@ _lock = threading.Lock()
 # (trace.cc ptt_span_record/ptt_span_drain); stale builds predate it.
 HAS_SPANS = False
 
+# True when the loaded .so carries the host-embedding PS kernels
+# (embed.cc pte_unique/pte_gather_f32/...); stale builds predate them and
+# the host-embedding table falls back to pure numpy.
+HAS_EMBED = False
+
 
 def _build():
     subprocess.run(["make", "-C", _RUNTIME_DIR], check=True, capture_output=True)
@@ -114,6 +119,42 @@ def lib():
             HAS_SPANS = True
         except AttributeError:
             HAS_SPANS = False
+        # host-embedding PS kernels (absent from pre-embed builds)
+        global HAS_EMBED
+        try:
+            L.pte_unique.restype = ctypes.c_int64
+            L.pte_unique.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64,
+            ]
+            L.pte_gather_f32.restype = ctypes.c_int
+            L.pte_gather_f32.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64,
+            ]
+            L.pte_sgd_f32.restype = ctypes.c_int
+            L.pte_sgd_f32.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_float, ctypes.c_int64,
+            ]
+            L.pte_adagrad_f32.restype = ctypes.c_int
+            L.pte_adagrad_f32.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
+            L.pte_merge_f32.restype = ctypes.c_int64
+            L.pte_merge_f32.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64,
+            ]
+            HAS_EMBED = True
+        except AttributeError:
+            HAS_EMBED = False
         # arena
         L.pta_create.restype = ctypes.c_void_p
         L.pta_create.argtypes = [ctypes.c_int64]
@@ -184,8 +225,11 @@ class TCPStore:
         if not self._client:
             raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
 
-    def _req(self, op, key, val=b""):
-        out = ctypes.create_string_buffer(1 << 20)
+    def _req(self, op, key, val=b"", max_bytes=None):
+        # the C side drains the full reply off the socket before the copy-out
+        # bounds check, so an undersized buffer LOSES the value (-2, not
+        # retryable) — callers expecting large replies must size up front
+        out = ctypes.create_string_buffer(max(1 << 20, int(max_bytes or 0)))
         out_len = ctypes.c_int64(0)
         status = self._L.pts_request(
             self._client, op, key.encode(), val, len(val), out, len(out), ctypes.byref(out_len)
@@ -199,8 +243,8 @@ class TCPStore:
             value = value.encode()
         self._req(self.SET, key, value)
 
-    def get(self, key):
-        status, val = self._req(self.GET, key)
+    def get(self, key, max_bytes=None):
+        status, val = self._req(self.GET, key, max_bytes=max_bytes)
         return val if status == 0 else None
 
     def add(self, key, amount=1):
@@ -209,8 +253,8 @@ class TCPStore:
         _, val = self._req(self.ADD, key, struct.pack("<q", amount))
         return struct.unpack("<q", val)[0]
 
-    def wait(self, key):
-        status, val = self._req(self.WAIT, key)
+    def wait(self, key, max_bytes=None):
+        status, val = self._req(self.WAIT, key, max_bytes=max_bytes)
         if status != 0:
             raise RuntimeError(f"TCPStore wait({key}) interrupted")
         return val

@@ -145,6 +145,34 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_hbm_admission": "off",
     "FLAGS_hbm_budget_bytes": 0,
     "FLAGS_hbm_reserve_bytes": 256 * 1024 * 1024,
+    # Host-embedding parameter server (incubate/host_embedding.py).
+    # FLAGS_host_emb_native routes the table's batched unique/gather and the
+    # SelectedRows-style sparse update through runtime_cpp/embed.cc
+    # (multi-threaded, bit-exact with the numpy fallback); it silently falls
+    # back when the .so is unbuilt/stale or the table dtype isn't float32.
+    # FLAGS_host_emb_threads caps the kernel thread count (0 = hardware).
+    # FLAGS_host_emb_cache_rows sizes the HBM hot-row cache (rows; 0 = off);
+    # admission needs FLAGS_host_emb_cache_min_count sightings, and when the
+    # PR 14 HBM budget is resolvable the cache is clamped to
+    # FLAGS_host_emb_cache_frac of it (and registers a free_pressure handler
+    # that halves it under memory pressure). FLAGS_host_emb_async_push makes
+    # apply_gradients enqueue the sparse update to the PS worker thread
+    # (host table work hides behind device execution; ordering vs later
+    # gathers/prefetches is preserved by the worker's FIFO). Sharded-table
+    # transport: FLAGS_host_emb_chunk_bytes per store message (the pre-PR
+    # path used 512 KiB), FLAGS_host_emb_transport_threads parallel store
+    # clients per peer exchange (0 = serial pre-PR behavior), and
+    # FLAGS_host_emb_push_fp16 opts into float16 cross-rank grad payloads
+    # (EQuARX-style byte shrink; lossy, off by default).
+    "FLAGS_host_emb_native": True,
+    "FLAGS_host_emb_threads": 16,
+    "FLAGS_host_emb_cache_rows": 0,
+    "FLAGS_host_emb_cache_min_count": 3,
+    "FLAGS_host_emb_cache_frac": 0.25,
+    "FLAGS_host_emb_async_push": False,
+    "FLAGS_host_emb_chunk_bytes": 4 * 1024 * 1024,
+    "FLAGS_host_emb_transport_threads": 4,
+    "FLAGS_host_emb_push_fp16": False,
     # JAX persistent compilation cache (warm executable starts across
     # processes). Dir defaults to ~/.cache/paddle_tpu/xla when unset.
     "FLAGS_xla_persistent_cache": True,

@@ -36,3 +36,7 @@ from .operators import (  # noqa: F401
     softmax_mask_fuse, softmax_mask_fuse_upper_triangle, graph_send_recv,
     graph_khop_sampler, ResNetUnit,
 )
+from .host_embedding import (  # noqa: F401
+    HostEmbedding, HostEmbeddingTable, HotRowCache,
+    ShardedHostEmbeddingTable, sharded_host_embedding,
+)

@@ -18,23 +18,75 @@ Flow per step (mirrors PS pull → dense compute → push):
     → out = rows[inverse]  (differentiable gather on device)
     → backward gives rows.grad (dense, small)
     → apply_gradients(): host scatter-update of the touched rows
+
+The hot path has three accelerations, each independently kill-switched and
+bit-exact against the pure-numpy fallback (the fallback IS the pre-PR
+per-step code, kept as the portable reference semantics):
+
+* **Native batched gather/scatter** (``FLAGS_host_emb_native``, default on):
+  ``runtime_cpp/embed.cc`` does the multi-threaded unique → gather-rows →
+  pack, the duplicate-id grad merge (np.add.at order preserved) and the
+  fused SelectedRows SGD / rowwise-Adagrad scatter directly on the
+  RAM/memmap table.
+
+* **HBM hot-row cache** (``FLAGS_host_emb_cache_rows`` > 0 or
+  ``HostEmbedding(cache_rows=)``): a device-resident cache for the head of
+  the id distribution with count-min frequency admission. Cached rows are
+  pulled from HBM and updated in place by the sparse push, so the hot head
+  never crosses PCIe again (grads still do — they already live on device);
+  eviction writes rows (and Adagrad accumulators) back to the host table.
+  The cache is clamped to ``FLAGS_host_emb_cache_frac`` of the PR 14 HBM
+  budget when one is resolvable, its buffers are ordinary live arrays the
+  admission census counts, and it registers a ``fault.memory``
+  free_pressure handler that halves it under memory pressure — it can
+  never cause an unmanaged OOM. Local tables only: a sharded table's rows
+  are owned by their rank and peers' pushes merge owner-side, which a
+  worker-local device copy would break.
+
+* **Pipelined pull/push**: next-batch ids are known at enqueue time —
+  ``prefetch(ids)`` (or the ``prefetch_iter`` wrapper) hands the unique +
+  gather + H2D to a persistent PS worker thread so the pull overlaps the
+  current step, and ``FLAGS_host_emb_async_push`` makes
+  ``apply_gradients`` enqueue the D2H + merge + scatter to the same
+  worker. The worker runs jobs in FIFO submission order, so a gather
+  submitted after a push always sees the updated table, and a push patches
+  any already-prefetched pack it overlaps (the prefetched rows are
+  re-gathered post-update and re-staged), keeping pipelined semantics
+  bit-identical to the synchronous path. The worker holds only a weakref
+  to the layer (PR 6 DevicePrefetcher discipline): abandoning the layer
+  releases the thread.
+
+The sharded table's pull/push transport is coalesced (one ids+grads
+payload per peer) and chunk-parallel (``FLAGS_host_emb_chunk_bytes`` per
+store message over ``FLAGS_host_emb_transport_threads`` dedicated store
+connections) instead of the pre-PR serial ≤512 KiB round trips;
+``FLAGS_host_emb_push_fp16`` optionally halves cross-rank push bytes.
 """
 from __future__ import annotations
 
 import os
-from typing import Optional
+import queue as _queue
+import struct
+import threading
+import time
+import weakref
+from typing import List, Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import as_tensor, eager_call
 from ..core.lazy import concrete as _concrete
 from ..core.tensor import Tensor
+from ..framework import flags as _flags
 from ..nn.layer.layers import Layer
+from .. import profiler as _prof
+from ..profiler.spans import span as _span
 
 __all__ = [
     "HostEmbeddingTable", "HostEmbedding", "ShardedHostEmbeddingTable",
-    "sharded_host_embedding",
+    "HotRowCache", "sharded_host_embedding",
 ]
 
 
@@ -49,19 +101,58 @@ def sharded_host_embedding(num_embeddings, embedding_dim, store=None, **kw):
     world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     if world <= 1:
         return HostEmbedding(num_embeddings, embedding_dim, **kw)
+    store_addr = None
     if store is None:
         from ..core.native import TCPStore
 
         host = os.environ.get("PADDLE_EMB_STORE_HOST", "127.0.0.1")
         port = int(os.environ.get("PADDLE_EMB_STORE_PORT", "23461"))
         store = TCPStore(host=host, port=port, is_master=(rank == 0))
+        # the table can open extra parallel-transport connections only when
+        # it knows the endpoint; a caller-provided store stays serial
+        store_addr = (host, port)
     table = ShardedHostEmbeddingTable(
         num_embeddings, embedding_dim, store=store, rank=rank, world_size=world,
         optimizer=kw.pop("optimizer", "sgd"), init_std=kw.pop("init_std", 0.01),
         seed=kw.pop("seed", 0), path=kw.pop("path", None),
-        name=kw.pop("name", None),
+        name=kw.pop("name", None), store_addr=store_addr,
     )
     return HostEmbedding(num_embeddings, embedding_dim, table=table)
+
+
+# -- native kernel dispatch ---------------------------------------------------
+def _native_ops():
+    """The embed.cc kernel library, or None when unbuilt/stale/disabled."""
+    if not _flags.flag("FLAGS_host_emb_native", True):
+        return None
+    from ..core import native
+
+    L = native.lib()
+    return L if (L is not None and native.HAS_EMBED) else None
+
+
+def _nthreads() -> int:
+    n = int(_flags.flag("FLAGS_host_emb_threads", 16) or 0)
+    return n if n > 0 else (os.cpu_count() or 1)
+
+
+def _c_f32(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, np.float32)
+
+
+def _unique(ids: np.ndarray):
+    """np.unique(ids, return_inverse=True), natively when available."""
+    ids = np.ascontiguousarray(ids, np.int64)
+    L = _native_ops()
+    if L is None or ids.size == 0:
+        return np.unique(ids, return_inverse=True)
+    uniq = np.empty(ids.size, np.int64)
+    inv = np.empty(ids.size, np.int64)
+    n = L.pte_unique(ids.ctypes.data, ids.size, uniq.ctypes.data,
+                     inv.ctypes.data, _nthreads())
+    if n < 0:
+        raise IndexError("host embedding: negative id in lookup batch")
+    return uniq[:n].copy(), inv
 
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:
@@ -75,17 +166,38 @@ def _splitmix64(x: np.ndarray) -> np.ndarray:
 
 def _merge_sparse_grads(ids_list, grads_list, dim: int):
     """Coalesce sparse grad pushes: concatenate, merge duplicate ids by
-    SUMMING their rows. Returns (unique_ids, merged_grads)."""
+    SUMMING their rows (in input order — np.add.at semantics, which the
+    native kernel reproduces bitwise). Returns (unique_ids, merged_grads)."""
     cat_ids = np.concatenate(ids_list) if ids_list else np.empty((0,), np.int64)
     if cat_ids.size == 0:
         return cat_ids, np.empty((0, dim), np.float32)
     cat_grads = np.concatenate(grads_list, axis=0)
+    L = _native_ops()
+    if L is not None and cat_grads.dtype == np.float32:
+        cat_ids = np.ascontiguousarray(cat_ids, np.int64)
+        cat_grads = np.ascontiguousarray(cat_grads)
+        uniq = np.empty(cat_ids.size, np.int64)
+        merged = np.empty((cat_ids.size, dim), np.float32)
+        n = L.pte_merge_f32(cat_ids.ctypes.data, cat_ids.size,
+                            cat_grads.ctypes.data, dim, uniq.ctypes.data,
+                            merged.ctypes.data, _nthreads())
+        if n < 0:
+            raise IndexError("host embedding: negative id in grad push")
+        return uniq[:n].copy(), merged[:n].copy()
     uniq, inv = np.unique(cat_ids, return_inverse=True)
     if uniq.size == cat_ids.size:  # no duplicates: reorder only
         return uniq, cat_grads[np.argsort(cat_ids, kind="stable")]
     merged = np.zeros((uniq.size, dim), np.float32)
     np.add.at(merged, inv, cat_grads)
     return uniq, merged
+
+
+def _pad_pow2(n: int, minimum: int = 16) -> int:
+    """Bucket a data-dependent length to a power of two: the device-side
+    cache ops (gather/concat/scatter) would otherwise compile one XLA
+    program per distinct unique-id count — unbounded recompilation on real
+    id streams. Pow-2 padding bounds the compile count logarithmically."""
+    return max(minimum, 1 << max(0, int(n - 1).bit_length()))
 
 
 def _hash_normal_rows(rows: np.ndarray, dim: int, seed: int, std: float) -> np.ndarray:
@@ -99,6 +211,41 @@ def _hash_normal_rows(rows: np.ndarray, dim: int, seed: int, std: float) -> np.n
     u1 = ((h1 >> np.uint64(11)).astype(np.float64) + 1.0) / 9007199254740993.0
     u2 = (h2 >> np.uint64(11)).astype(np.float64) / 9007199254740992.0
     return (std * np.sqrt(-2.0 * np.log(u1)) * np.cos(2.0 * np.pi * u2)).astype(np.float32)
+
+
+# One cached probe: does this filesystem report hole blocks honestly?
+# Overlay-backed CI containers report st_blocks == file size from the
+# moment of truncation (while still materializing lazily), which makes the
+# st_blocks reading useless for the "lazy init keeps the table sparse"
+# assertion — the fallback accounts initialized rows instead.
+_fs_sparse_probe = {}
+
+
+def _fs_reports_sparse_blocks(probe_dir: str) -> bool:
+    probe_dir = probe_dir or "/tmp"
+    if probe_dir in _fs_sparse_probe:
+        return _fs_sparse_probe[probe_dir]
+    import tempfile
+
+    ok = False
+    try:
+        with tempfile.NamedTemporaryFile(dir=probe_dir) as f:
+            f.truncate(4 * 1024 * 1024)
+            # write ONE page through a mapping, like the table does: an fs
+            # may report holes honestly at truncation yet materialize them
+            # on first write-through (the failure the pre-PR skipif
+            # guarded); only "holes stayed holes after a write" makes the
+            # st_blocks reading trustworthy
+            m = np.memmap(f.name, dtype=np.float32, mode="r+",
+                          shape=(1024, 1024))
+            m[0] = 1.0
+            m.flush()
+            del m
+            ok = os.fstat(f.fileno()).st_blocks * 512 < 2 * 1024 * 1024
+    except Exception:
+        ok = False
+    _fs_sparse_probe[probe_dir] = ok
+    return ok
 
 
 class HostEmbeddingTable:
@@ -147,6 +294,7 @@ class HostEmbeddingTable:
         # nothing until used — the reference's sparse tables create entries
         # on first feature occurrence the same way
         self._initialized = np.zeros(self.num_embeddings, bool)
+        self._n_initialized = 0
 
     def _ensure_init(self, ids: np.ndarray):
         fresh = np.unique(ids[~self._initialized[ids]])
@@ -161,19 +309,61 @@ class HostEmbeddingTable:
             fresh, self.embedding_dim, self.seed, self.init_std
         ).astype(self.dtype)
         self._initialized[fresh] = True
+        self._n_initialized += int(fresh.size)
+
+    def _native_table(self):
+        """The kernel library when it can operate on this table directly
+        (float32, C-contiguous — RAM or memmap alike), else None."""
+        if self.dtype != np.float32 or not self.table.flags.c_contiguous:
+            return None
+        return _native_ops()
 
     def gather(self, ids: np.ndarray) -> np.ndarray:
-        ids = np.asarray(ids, np.int64)
+        ids = np.ascontiguousarray(ids, np.int64)
         self._ensure_init(ids)
-        return np.asarray(self.table[ids])
+        L = self._native_table()
+        if L is None or ids.size == 0:
+            return np.asarray(self.table[ids])
+        out = np.empty((ids.size, self.embedding_dim), np.float32)
+        rc = L.pte_gather_f32(self.table.ctypes.data, self.num_embeddings,
+                              self.embedding_dim, ids.ctypes.data, ids.size,
+                              out.ctypes.data, _nthreads())
+        if rc != 0:
+            raise IndexError("host embedding: id out of range in gather")
+        return out
 
     def apply_update(self, ids: np.ndarray, grad: np.ndarray, lr: float):
         """SelectedRows-style sparse optimizer step on the touched rows
-        (reference sparse_sgd_rule.cc: SGD / rowwise Adagrad)."""
-        ids = np.asarray(ids, np.int64)
-        grad = np.asarray(grad, np.float32)
+        (reference sparse_sgd_rule.cc: SGD / rowwise Adagrad). ``ids`` must
+        be unique (callers merge duplicates first)."""
+        ids = np.ascontiguousarray(ids, np.int64)
+        grad = _c_f32(grad)
+        if ids.size == 0:
+            return
+        L = self._native_table()
+        if L is not None:
+            import ctypes
+
+            if self.optimizer == "adagrad":
+                rc = L.pte_adagrad_f32(
+                    self.table.ctypes.data, self._accum.ctypes.data,
+                    self.num_embeddings, self.embedding_dim, ids.ctypes.data,
+                    ids.size, grad.ctypes.data, ctypes.c_float(lr),
+                    ctypes.c_float(self.adagrad_eps), _nthreads())
+            else:
+                rc = L.pte_sgd_f32(
+                    self.table.ctypes.data, self.num_embeddings,
+                    self.embedding_dim, ids.ctypes.data, ids.size,
+                    grad.ctypes.data, ctypes.c_float(lr), _nthreads())
+            if rc != 0:
+                raise IndexError("host embedding: id out of range in update")
+            return
         if self.optimizer == "adagrad":
-            g2 = (grad * grad).mean(axis=1)
+            # float64 cumsum forces a SEQUENTIAL per-row sum — the one numpy
+            # reduction order the native kernel can reproduce bitwise at any
+            # dim (np.mean's pairwise blocking would diverge past dim 128)
+            g2 = (grad.astype(np.float64) ** 2).cumsum(axis=1)[:, -1]
+            g2 = (g2 / float(self.embedding_dim)).astype(np.float32)
             self._accum[ids] += g2
             scale = lr / (np.sqrt(self._accum[ids]) + self.adagrad_eps)
             self.table[ids] = (
@@ -185,11 +375,1005 @@ class HostEmbeddingTable:
             ).astype(self.dtype)
 
     def state_nbytes_physical(self) -> int:
-        """Resident bytes of the backing file (0 blocks for untouched rows)."""
+        """Resident bytes of the backing file (0 blocks for untouched rows).
+        On filesystems whose ``st_blocks`` can't see holes (overlay/tmpfs CI
+        mounts report full allocation at truncation), fall back to the
+        lazy-init accounting: initialized rows × row bytes + header page."""
         if isinstance(self.table, np.memmap):
-            st = os.stat(self.table.filename)
-            return st.st_blocks * 512
+            if _fs_reports_sparse_blocks(os.path.dirname(self.table.filename)):
+                return os.stat(self.table.filename).st_blocks * 512
+            row = self.embedding_dim * self.dtype.itemsize
+            return self._n_initialized * row + 4096
         return self.table.nbytes
+
+
+# -- fused device helpers -----------------------------------------------------
+# One jitted call per staging/update instead of an eager-op chain: on a busy
+# host each eager dispatch costs as much as the whole kernel, and the PS
+# worker issues several per microbatch. Shapes are HWM-bucketed, so each
+# compiles a handful of times; lr rides as a traced scalar (no per-value
+# recompiles).
+@jax.jit
+def _jit_pack(buf, slots, cold):
+    return jnp.concatenate([buf[slots], cold], axis=0)
+
+
+@jax.jit
+def _jit_gather_rows(buf, slots):
+    return buf[slots]
+
+
+@jax.jit
+def _jit_sgd_cache(buf, slots, g, lr):
+    return buf.at[slots].add(-(lr * g))
+
+
+@jax.jit
+def _jit_row_set(buf, pos, vals):
+    # pad lanes carry pos == len(buf): 'drop' discards them instead of the
+    # default out-of-bounds clamp (which would corrupt the last row)
+    return buf.at[pos].set(vals, mode="drop")
+
+
+@jax.jit
+def _jit_dense_sgd(buf, g, lr):
+    # dense SGD over the whole cache buffer: rows with zero grad are
+    # bitwise unchanged (x - 0.0 == x), touched rows match the scatter
+    # rule exactly (x + -(lr*g) == x - lr*g)
+    return buf - lr * g
+
+
+@jax.jit
+def _jit_ada_cache(buf, acc, slots, g, lr, eps):
+    acc = acc.at[slots].add(jnp.mean(g * g, axis=1))
+    scale = lr / (jnp.sqrt(acc[slots]) + eps)
+    return buf.at[slots].add(-scale[:, None] * g), acc
+
+
+# -- HBM hot-row cache --------------------------------------------------------
+class HotRowCache:
+    """Device-resident cache for the head of the id distribution.
+
+    Admission is frequency-based: a 2-row count-min sketch tracks how often
+    each missed id appears across steps; ids seen at least ``min_count``
+    times are admitted (into free slots first, then over colder occupants).
+    Cached rows are read from the device buffer on pull and updated in
+    place by the sparse push; eviction and :meth:`flush` write rows (and
+    Adagrad accumulators) back to the host table, so host and device
+    together always hold exactly one authoritative copy per row.
+
+    Sizing is budget-aware (PR 14): when ``fault.memory.budget_bytes()``
+    resolves, capacity is clamped to ``FLAGS_host_emb_cache_frac`` of it,
+    and a ``free_pressure`` handler (weakly owned, auto-unregistered) halves
+    the cache under memory pressure — the shrink itself happens on the
+    owner's thread at the next touch, like the serving pool's handler.
+    """
+
+    def __init__(self, table: HostEmbeddingTable, capacity: int,
+                 min_count: Optional[int] = None):
+        self.table = table
+        self.dim = table.embedding_dim
+        self.min_count = int(min_count if min_count is not None
+                             else _flags.flag("FLAGS_host_emb_cache_min_count", 3))
+        cap = int(capacity)
+        bytes_per_row = self.dim * 4 + (4 if table.optimizer == "adagrad" else 0)
+        budget = 0
+        try:
+            from ..fault import memory as _mem
+
+            budget = int(_mem.budget_bytes() or 0)
+            if budget > 0:
+                frac = float(_flags.flag("FLAGS_host_emb_cache_frac", 0.25))
+                cap = max(1, min(cap, int(budget * frac / bytes_per_row)))
+            _mem.register_pressure_handler(
+                f"host_emb_cache:{id(self):x}",
+                lambda o: o._request_shrink(), owner=self)
+        except Exception:
+            pass
+        self.capacity = cap
+        self.budget_bytes = budget
+        # one extra DUMMY row (index == capacity, never indexed by a real
+        # slot): shape-padded gathers/scatters aim their pad lanes at it,
+        # the serving PagePool's trash-block trick
+        self._rows = jnp.zeros((cap + 1, self.dim), jnp.float32)
+        self._accum = (jnp.zeros((cap + 1,), jnp.float32)
+                       if table.optimizer == "adagrad" else None)
+        # SGD runs the cache in DENSE-LEAF mode: the buffer is an autograd
+        # leaf the forward graph gathers from, so hot-row grads accumulate
+        # on it across microbatches (coalescing for free, summed in the
+        # same per-row order np.add.at uses) and the push is ONE dense
+        # in-graph update — hot rows AND their grads never leave the
+        # device. Adagrad keeps the scatter path (its per-microbatch accum
+        # semantics need per-microbatch grads).
+        self.dense = table.optimizer == "sgd"
+        self.rows_t: Optional[Tensor] = (
+            Tensor(self._rows, stop_gradient=False) if self.dense else None)
+        self._slot_ids = np.full(cap, -1, np.int64)
+        self._slot_hits = np.zeros(cap, np.int64)
+        self._free: List[int] = list(range(cap - 1, -1, -1))
+        self._ids_sorted = np.empty(0, np.int64)
+        self._slots_sorted = np.empty(0, np.int64)
+        # count-min sketch for admission (uint32 saturating is irrelevant at
+        # step scale; two independent splitmix streams)
+        self._cmw = 1 << max(10, (cap * 4).bit_length())
+        self._cms = np.zeros((2, self._cmw), np.int64)
+        self.hits = 0
+        self.misses = 0
+        self._shrink_req = False  # set from the pressure handler's thread
+
+    def _set_rows(self, new_rows):
+        self._rows = new_rows
+        if self.dense:
+            self.rows_t = Tensor(new_rows, stop_gradient=False)
+
+    def dense_update(self, grad, lr: float):
+        """Apply the accumulated dense hot-grad (one jitted op; grads stay
+        device-resident end to end)."""
+        from ..core.lazy import concrete as _conc
+
+        g = _conc(grad._data) if isinstance(grad, Tensor) else grad
+        self._set_rows(_jit_dense_sgd(self._rows, g, np.float32(lr)))
+
+    # -- membership --------------------------------------------------------
+    def lookup(self, uniq: np.ndarray, count_stats: bool = True):
+        """(hit_mask, slots_of_hits) for sorted-or-not unique ids.
+        ``count_stats=False`` for push-side routing lookups: only the PULL
+        defines hit-rate and eviction heat, or every id would be counted
+        twice per step.
+
+        A pending pressure shrink is NOT applied here: renumbering slots
+        and swapping the dense leaf mid-step would orphan staged prefetch
+        packs and in-step accumulated grads — the owning layer applies it
+        at the push (post-grad-consumption) and invalidates its staging."""
+        if self._ids_sorted.size == 0:
+            return np.zeros(uniq.shape, bool), np.empty(0, np.int64)
+        pos = np.searchsorted(self._ids_sorted, uniq)
+        pos_c = np.minimum(pos, self._ids_sorted.size - 1)
+        hit = self._ids_sorted[pos_c] == uniq
+        slots = self._slots_sorted[pos_c[hit]]
+        if count_stats:
+            self._slot_hits[slots] += 1
+            self.hits += int(hit.sum())
+            self.misses += int(uniq.size - hit.sum())
+        return hit, slots
+
+    def _cm_hashes(self, ids: np.ndarray):
+        """The count-min sketch's two bucket streams — ONE definition, or a
+        drifted edit would write sightings to different buckets than
+        admission reads (a cache that silently never admits)."""
+        u = ids.astype(np.uint64)
+        h0 = (_splitmix64(u) & np.uint64(self._cmw - 1)).astype(np.int64)
+        h1 = (_splitmix64(u ^ np.uint64(0xD6E8FEB86659FD93)) &
+              np.uint64(self._cmw - 1)).astype(np.int64)
+        return h0, h1
+
+    def observe_misses(self, missed_uniq: np.ndarray):
+        """Count-min update for missed ids (one sighting per step each)."""
+        if missed_uniq.size == 0:
+            return
+        h0, h1 = self._cm_hashes(missed_uniq)
+        self._cms[0] += np.bincount(h0, minlength=self._cmw)
+        self._cms[1] += np.bincount(h1, minlength=self._cmw)
+
+    def admission_candidates(self, missed_uniq: np.ndarray) -> np.ndarray:
+        if missed_uniq.size == 0 or not self._free:
+            return missed_uniq[:0]
+        h0, h1 = self._cm_hashes(missed_uniq)
+        est = np.minimum(self._cms[0][h0], self._cms[1][h1])
+        cand = missed_uniq[est >= self.min_count]
+        return cand[:len(self._free)]
+
+    # -- admission / eviction ---------------------------------------------
+    def _pad_slots(self, slots: np.ndarray) -> np.ndarray:
+        """Pad a slot vector to a grow-only pow-2 length with the dummy
+        slot (stable scatter shapes, one compile after warmup)."""
+        self._pad_hwm = max(getattr(self, "_pad_hwm", 16), _pad_pow2(slots.size))
+        p = self._pad_hwm
+        if p == slots.size:
+            return slots
+        out = np.full(p, self.capacity, np.int64)
+        out[: slots.size] = slots
+        return out
+
+    def admit(self, ids: np.ndarray, rows: np.ndarray,
+              accum: Optional[np.ndarray] = None):
+        """Install host rows (one H2D — the last PCIe crossing these rows
+        make until eviction). Caller passes post-update values."""
+        k = min(int(ids.size), len(self._free))
+        if k == 0:
+            return
+        ids = ids[:k]
+        slots = np.array([self._free.pop() for _ in range(k)], np.int64)
+        self._slot_ids[slots] = ids
+        self._slot_hits[slots] = 1
+        padded = self._pad_slots(slots)
+        vals = np.zeros((padded.size, self.dim), np.float32)
+        vals[:k] = _c_f32(rows[:k])
+        sl = jnp.asarray(padded)
+        self._set_rows(self._rows.at[sl].set(jnp.asarray(vals)))
+        if self._accum is not None:
+            a = np.zeros(padded.size, np.float32)
+            if accum is not None:
+                a[:k] = _c_f32(accum[:k])
+            self._accum = self._accum.at[sl].set(jnp.asarray(a))
+        self._rebuild_index()
+        _prof.counter_inc("host_emb_cache_admitted", k)
+
+    def evict(self, slots: np.ndarray):
+        """Write back and free the given slots."""
+        slots = np.asarray(slots, np.int64)
+        slots = slots[self._slot_ids[slots] >= 0]
+        if slots.size == 0:
+            return
+        ids = self._slot_ids[slots]
+        rows = np.asarray(self._rows[jnp.asarray(slots)])
+        self.table._ensure_init(ids)  # row may predate its first host touch
+        self.table.table[ids] = rows.astype(self.table.dtype)
+        if self._accum is not None:
+            self.table._accum[ids] = np.asarray(self._accum[jnp.asarray(slots)])
+        self._slot_ids[slots] = -1
+        self._slot_hits[slots] = 0
+        self._free.extend(int(s) for s in slots)
+        self._rebuild_index()
+        _prof.counter_inc("host_emb_cache_evicted", int(slots.size))
+
+    def flush(self):
+        """Write every cached row back to the host table (rows STAY cached;
+        the device remains authoritative for future updates). Gives
+        checkpoint/eval readers a coherent host snapshot."""
+        occ = np.nonzero(self._slot_ids >= 0)[0]
+        if occ.size == 0:
+            return
+        ids = self._slot_ids[occ]
+        rows = np.asarray(self._rows[jnp.asarray(occ)])
+        self.table._ensure_init(ids)
+        self.table.table[ids] = rows.astype(self.table.dtype)
+        if self._accum is not None:
+            self.table._accum[ids] = np.asarray(self._accum[jnp.asarray(occ)])
+
+    # -- sparse update ------------------------------------------------------
+    def update(self, slots: np.ndarray, grad: np.ndarray, lr: float):
+        """Device-side SelectedRows update of cached rows (the push's hot
+        half). SGD is bitwise-identical to the host rule; Adagrad matches to
+        reduction-order rounding (device mean vs sequential host sum). Pad
+        lanes aim zero grads at the dummy row (zero update, and the dummy's
+        accum stays finite so its scale can't NaN)."""
+        k = int(np.asarray(slots).size)
+        padded = self._pad_slots(np.asarray(slots, np.int64))
+        gp = np.zeros((padded.size, self.dim), np.float32)
+        gp[:k] = _c_f32(grad)
+        sl = jnp.asarray(padded)
+        g = jnp.asarray(gp)
+        if self._accum is not None:
+            rows, self._accum = _jit_ada_cache(
+                self._rows, self._accum, sl, g, np.float32(lr),
+                np.float32(self.table.adagrad_eps))
+            self._set_rows(rows)
+        else:
+            self._set_rows(_jit_sgd_cache(self._rows, sl, g, np.float32(lr)))
+
+    def rows_device(self, slots: np.ndarray):
+        """Device gather of cached rows (no host crossing)."""
+        return _jit_gather_rows(self._rows,
+                                jnp.asarray(np.asarray(slots, np.int64)))
+
+    # -- pressure ----------------------------------------------------------
+    def _request_shrink(self):
+        # called on the free_pressure caller's thread: cheap flag only, the
+        # owner applies it at its next touch (serving-pool discipline)
+        self._shrink_req = True
+        occ = int((self._slot_ids >= 0).sum())
+        return {"requested": True, "occupied_rows": occ,
+                "capacity_rows": self.capacity}
+
+    def _apply_shrink(self):
+        self._shrink_req = False
+        new_cap = max(1, self.capacity // 2)
+        occ = np.nonzero(self._slot_ids >= 0)[0]
+        if occ.size > new_cap:
+            # keep the hottest; write the cold half back
+            order = np.argsort(self._slot_hits[occ], kind="stable")
+            self.evict(occ[order[: occ.size - new_cap]])
+            occ = np.nonzero(self._slot_ids >= 0)[0]
+        # rebuild smaller device buffers (frees the old allocation); keep
+        # the extra dummy row at index == new capacity
+        keep_ids = self._slot_ids[occ]
+        keep_rows = self._rows[jnp.asarray(occ)][:new_cap]
+        rows = jnp.zeros((new_cap + 1, self.dim), jnp.float32)
+        self._set_rows(rows.at[jnp.arange(keep_ids.size)].set(keep_rows))
+        if self._accum is not None:
+            keep_acc = self._accum[jnp.asarray(occ)][:new_cap]
+            acc = jnp.zeros((new_cap + 1,), jnp.float32)
+            self._accum = acc.at[jnp.arange(keep_ids.size)].set(keep_acc)
+        hits = self._slot_hits[occ]
+        self.capacity = new_cap
+        self._slot_ids = np.full(new_cap, -1, np.int64)
+        self._slot_hits = np.zeros(new_cap, np.int64)
+        self._slot_ids[: keep_ids.size] = keep_ids
+        self._slot_hits[: keep_ids.size] = hits
+        self._free = list(range(new_cap - 1, keep_ids.size - 1, -1))
+        self._rebuild_index()
+        _prof.counter_inc("host_emb_cache_shrinks")
+
+    def _rebuild_index(self):
+        occ = np.nonzero(self._slot_ids >= 0)[0]
+        ids = self._slot_ids[occ]
+        order = np.argsort(ids)
+        self._ids_sorted = ids[order]
+        self._slots_sorted = occ[order].astype(np.int64)
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "capacity_rows": self.capacity,
+            "occupied_rows": int((self._slot_ids >= 0).sum()),
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": (self.hits / total) if total else 0.0,
+        }
+
+
+# -- pipelined PS worker ------------------------------------------------------
+class _PSWorker:
+    """One persistent daemon thread running the layer's host-side PS jobs
+    (prefetch gathers, async pushes) in FIFO order. Holds only a WEAKREF to
+    the owning layer: abandoning the layer fires a finalizer that wakes the
+    queue with a sentinel so the thread exits instead of pinning the table
+    (the PR 6 DevicePrefetcher discipline)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, owner):
+        self._q: _queue.Queue = _queue.Queue()
+        self._thread = threading.Thread(
+            target=_PSWorker._loop, args=(weakref.ref(owner), self._q),
+            daemon=True, name="host-emb-ps",
+        )
+        self._finalizer = weakref.finalize(owner, self._q.put, _PSWorker._SENTINEL)
+        self._thread.start()
+
+    def submit(self, kind: str, payload: dict):
+        self._q.put((kind, payload))
+
+    def join(self):
+        self._q.join()
+
+    @staticmethod
+    def _loop(owner_ref, q):
+        while True:
+            job = q.get()
+            try:
+                if job is _PSWorker._SENTINEL:
+                    return
+                owner = owner_ref()
+                if owner is None:
+                    return
+                kind, payload = job
+                try:
+                    if kind == "gather":
+                        owner._job_gather(payload)
+                    else:
+                        owner._job_apply(payload)
+                except Exception as e:  # surfaced at consume/sync
+                    payload["err"] = e
+                    ev = payload.get("done")
+                    if ev is not None:
+                        ev.set()
+                    owner._async_err = e
+                finally:
+                    del owner
+            finally:
+                q.task_done()
+
+
+class HostEmbedding(Layer):
+    """Embedding layer over a HostEmbeddingTable.
+
+    Eager-mode by design: the gather crosses the host boundary, exactly like
+    the reference's PS pull — the dense model around it can still run
+    compiled. Call ``apply_gradients(lr)`` after ``backward()`` (the role of
+    the PS push / SelectedRows optimizer).
+
+    ``cache_rows`` (or ``FLAGS_host_emb_cache_rows``) arms the HBM hot-row
+    cache; ``prefetch``/``prefetch_iter`` and ``FLAGS_host_emb_async_push``
+    pipeline the pull/push through the PS worker thread. With everything at
+    defaults the layer is the plain synchronous host path: no threads, no
+    cache, no native entry points beyond the flag probe.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, path=None, optimizer="sgd",
+                 init_std=0.01, seed=0, sparse=True, name=None, table=None,
+                 cache_rows=None):
+        super().__init__()
+        # table=ShardedHostEmbeddingTable(...) makes this layer the worker
+        # side of a multi-process PS (fleet wires this up from env)
+        self.table = table or HostEmbeddingTable(
+            num_embeddings, embedding_dim, path=path, optimizer=optimizer,
+            init_std=init_std, seed=seed,
+        )
+        self._pending = []  # (pack_order_ids, rows_tensor) awaiting push
+        # one lock serializes table reads (PS worker thread) against the
+        # sparse updates — torn rows are silent corruption
+        self._table_lock = threading.Lock()
+        self._worker: Optional[_PSWorker] = None
+        self._slots: List[dict] = []  # in-flight prefetch slots, FIFO
+        self._async_err: Optional[BaseException] = None
+        # ordering barrier for async pushes: staged packs may be patched by
+        # an in-flight push, so a pull consumes them only after the LAST
+        # submitted push (and its patches) completed. _push_seq counts async
+        # submissions; a slot prefetched at the current seq needs no barrier
+        # (worker FIFO already ran every earlier push before its gather).
+        self._last_push_done: Optional[threading.Event] = None
+        self._push_seq = 0
+        if cache_rows is None:
+            cache_rows = int(_flags.flag("FLAGS_host_emb_cache_rows", 0) or 0)
+        self.cache: Optional[HotRowCache] = None
+        if cache_rows > 0 and not isinstance(self.table, ShardedHostEmbeddingTable):
+            self.cache = HotRowCache(self.table, cache_rows)
+        # high-water-mark shape buckets per pack segment: grow-only pow-2
+        # padding converges on ONE stable shape per segment, so the traced
+        # step graph (keyed by every microbatch's pack shape) compiles a
+        # handful of times instead of once per unique-count combination
+        self._pad_hwm = {"hot": 16, "cold": 16, "plain": 16, "patch": 16}
+
+    # -- PS worker ----------------------------------------------------------
+    def _ensure_worker(self) -> _PSWorker:
+        if self._worker is None:
+            self._worker = _PSWorker(self)
+        return self._worker
+
+    def _check_async_err(self):
+        if self._async_err is not None:
+            err, self._async_err = self._async_err, None
+            raise RuntimeError("host embedding PS worker failed") from err
+
+    def sync(self):
+        """Drain the PS worker (pending prefetches + async pushes). Call
+        before reading table state externally (checkpoint, eval snapshots).
+        Flushes the hot-row cache to the host table as well."""
+        if self._worker is not None:
+            t0 = time.perf_counter_ns()
+            self._worker.join()
+            _prof.counter_inc("host_emb_block_ns",
+                              time.perf_counter_ns() - t0)
+        self._check_async_err()
+        if self.cache is not None:
+            with self._table_lock:
+                self.cache.flush()
+
+    # -- pipelined pull -----------------------------------------------------
+    def prefetch(self, x):
+        """Start the host pull for upcoming batches on the PS worker thread
+        so it overlaps the current device step (the reference's buffered PS
+        pull): unique → cold-row gather → device_put, all off the critical
+        path. ``x`` is one id batch or a LIST of them (a whole step's
+        microbatches): a list stages ONE union pack in ONE worker job —
+        next-step ids are all known at enqueue time, so an 8-microbatch
+        step costs one queue round trip and one unique/gather instead of
+        eight. forward() consumes the staged sub-batches in order as ids
+        match.
+
+        No-op on a SHARDED table: its gather is a lockstep collective across
+        ranks, and an extra/mismatched gather from a background thread would
+        desynchronize the exchange protocol."""
+        if isinstance(self.table, ShardedHostEmbeddingTable):
+            return
+        self._check_async_err()
+        batches = x if isinstance(x, (list, tuple)) else [x]
+        ids_list = [
+            np.ascontiguousarray(
+                np.asarray(b._data if isinstance(b, Tensor) else b),
+                np.int64).ravel()
+            for b in batches
+        ]
+        # keyed on the RAW id bytes: the trainer-side cost of a prefetch (and
+        # of consuming one) is a memcpy + dict fields — the unique/inverse
+        # run on the worker with everything else
+        slot = {"keys": [i.tobytes() for i in ids_list], "ids_list": ids_list,
+                "cursor": 0, "uniq": None, "stage": None, "invs": None,
+                "inverse_u": None, "stale": False, "seq": self._push_seq,
+                "done": threading.Event(), "err": None}
+        self._slots.append(slot)
+        # bound the queue: a caller whose forwards never match its
+        # prefetches (wrong batch handed in) must not accumulate staged
+        # packs without limit — drop the oldest instead
+        while len(self._slots) > 8:
+            self._slots.pop(0)
+            _prof.counter_inc("host_emb_prefetch_drops")
+        self._ensure_worker().submit("gather", slot)
+
+    def prefetch_iter(self, it, lookahead: int = 1):
+        """Wrap an iterator of id batches: keeps ``lookahead`` batches'
+        pulls in flight so every ``forward`` consumes a staged pack.
+        Abandoning the (half-consumed) generator drops the layer's slot
+        refs; the worker thread itself is owned by the layer, not the
+        iterator, and dies with the layer (weakref discipline)."""
+        it = iter(it)
+        ahead = []
+        try:
+            for _ in range(max(1, lookahead)):
+                nxt = next(it, None)
+                if nxt is None:
+                    break
+                self.prefetch(nxt)
+                ahead.append(nxt)
+            while ahead:
+                cur = ahead.pop(0)
+                nxt = next(it, None)
+                if nxt is not None:
+                    self.prefetch(nxt)
+                    ahead.append(nxt)
+                yield cur
+        finally:
+            ahead.clear()
+
+    def _job_gather(self, slot):
+        """(worker thread) unique the slot's ids, gather the rows and stage
+        them device-side: cache hits are read on device, cold rows gathered
+        from the host table (native kernels) and device_put, the final
+        inverse precomputed — consuming the slot costs the trainer a key
+        compare. Slot fields are assigned under the table lock so a
+        concurrent push's patch pass and this staging can never interleave
+        half-written."""
+        cat = (np.concatenate(slot["ids_list"])
+               if len(slot["ids_list"]) > 1 else slot["ids_list"][0])
+        with _span("host_emb.prefetch", rows=int(cat.size),
+                   batches=len(slot["ids_list"])):
+            uniq, inverse = _unique(cat)
+            with self._table_lock:
+                stage = self._build_pack(uniq, pad=True)
+                slot["uniq"] = uniq
+                slot["inverse_u"] = inverse  # uniq-space; patch reuses it
+                slot["stage"] = stage
+                slot["invs"] = self._split_invs(slot, stage, inverse)
+        slot["done"].set()
+
+    @staticmethod
+    def _split_invs(slot, stage, inverse):
+        """Per-sub-batch inverse vectors (pack-space) out of the union
+        inverse."""
+        inv = (stage["perm"][inverse] if stage["perm"] is not None
+               else inverse)
+        out, off = [], 0
+        for ids in slot["ids_list"]:
+            out.append(inv[off:off + ids.size])
+            off += ids.size
+        return out
+
+    def _bucket(self, segment: str, n: int) -> int:
+        hwm = max(self._pad_hwm[segment], _pad_pow2(n))
+        self._pad_hwm[segment] = hwm
+        return hwm
+
+    def _build_pack(self, uniq: np.ndarray, pad: bool = False):
+        """Stage rows for unique ids; returns a STAGE dict the trainer turns
+        into tensors with :meth:`_stage_to_rows`. Caller holds the table
+        lock.
+
+        Modes: ``dense`` — SGD hot-row cache; only the cold rows and the
+        (padded) hot slot vector are staged, the hot gather + concat are
+        recorded into the step graph at forward time against the cache's
+        LEAF buffer (grads accumulate densely on it, hot rows and grads
+        never leave the device). ``packed`` — Adagrad cache: the combined
+        pack is computed here (one jitted call). ``plain`` — no cache.
+
+        ``pad`` buckets the hot/cold segment lengths to powers of two
+        (dummy-slot gathers, zero rows, ``-1`` order_ids filtered at push)
+        so the device ops and the traced step graph see a bounded shape
+        vocabulary instead of one compile per distinct unique-count — the
+        cache and prefetch paths always pad; the plain synchronous fallback
+        keeps the exact pre-PR unpadded shapes."""
+        cache = self.cache
+        dim = self.table.embedding_dim
+        if cache is not None:
+            pad = True
+            hit, slots = cache.lookup(uniq)
+            nh = int(hit.sum())
+        else:
+            hit, slots, nh = None, None, 0
+        if nh:
+            cold_uniq = uniq[~hit]
+            nc = int(cold_uniq.size)
+            cache.observe_misses(cold_uniq)
+            hp = self._bucket("hot", nh)
+            hot_slots = np.full(hp, cache.capacity, np.int64)
+            hot_slots[:nh] = slots
+            sl = jnp.asarray(hot_slots)
+            if nc:
+                cp = self._bucket("cold", nc)
+                cold_p = np.zeros((cp, dim), np.float32)
+                cold_p[:nc] = self.table.gather(cold_uniq)
+                cold_ids = np.full(cp, -1, np.int64)
+                cold_ids[:nc] = cold_uniq
+                cold_dev = jnp.asarray(cold_p)
+            else:
+                cp, cold_ids, cold_dev, cold_p = 0, None, None, None
+            perm = np.empty(uniq.size, np.int64)
+            perm[hit] = np.arange(nh)
+            perm[~hit] = hp + np.arange(nc)
+            _prof.counter_inc("host_emb_hot_hits", nh)
+            _prof.counter_inc("host_emb_hot_misses", nc)
+            if cache.dense:
+                return {"mode": "dense", "hot_slots_dev": sl,
+                        "cold_dev": cold_dev, "cold_ids": cold_ids,
+                        "perm": perm}
+            pack = (_jit_pack(cache._rows, sl, cold_dev) if nc
+                    else _jit_gather_rows(cache._rows, sl))
+            order_ids = np.full(hp + cp, -1, np.int64)
+            order_ids[:nh] = uniq[hit]
+            order_ids[hp:hp + nc] = cold_uniq
+            return {"mode": "packed", "pack": pack, "order_ids": order_ids,
+                    "perm": perm}
+        if cache is not None:
+            cache.observe_misses(uniq)
+            _prof.counter_inc("host_emb_hot_misses", int(uniq.size))
+        nu = int(uniq.size)
+        if pad:
+            p = self._bucket("plain", nu)
+            rows_p = np.zeros((p, dim), np.float32)
+            rows_p[:nu] = self.table.gather(uniq)
+            pack = jnp.asarray(rows_p)
+            order_ids = np.full(p, -1, np.int64)
+            order_ids[:nu] = uniq
+        else:
+            pack = jnp.asarray(self.table.gather(uniq))
+            order_ids = uniq
+        mode = "dense_cold" if (cache is not None and cache.dense) else "plain"
+        return {"mode": mode, "pack": pack, "order_ids": order_ids,
+                "perm": None}
+
+    def _stage_to_rows(self, stage):
+        """(trainer) turn a stage into the differentiable rows tensor plus
+        the push-pending entry. Dense stages RECORD the hot gather + concat
+        lazily against the cache's leaf buffer — pure graph bookkeeping, no
+        device dispatch — so the combine executes fused into the step's
+        flush; grads land densely on the buffer (hot) and on the cold leaf
+        (pushed to the host table)."""
+        mode = stage["mode"]
+        if mode == "dense":
+            # SGD: ONE cold LEAF per stage, shared by every sub-batch that
+            # consumes it — cold grads (like the dense buffer's hot grads)
+            # accumulate across microbatches, so the push moves one leaf's
+            # worth of bytes, not one per microbatch. The pack op itself is
+            # re-recorded per consume: backward frees graph NODES, only
+            # leaves survive across microbatch backwards.
+            buf_t = self.cache.rows_t
+            if "slots_t" not in stage:
+                stage["slots_t"] = Tensor(stage["hot_slots_dev"])
+            if stage["cold_dev"] is not None:
+                pend = None
+                if "cold_t" not in stage:
+                    stage["cold_t"] = Tensor(stage["cold_dev"],
+                                             stop_gradient=False)
+                    pend = (stage["cold_ids"], stage["cold_t"])
+                rows = eager_call(
+                    "host_emb_pack",
+                    lambda b, s, c: jnp.concatenate([b[s], c], axis=0),
+                    [buf_t, stage["slots_t"], stage["cold_t"]],
+                )
+                return rows, pend
+            rows = eager_call(
+                "host_emb_pack_hot", lambda b, s: b[s],
+                [buf_t, stage["slots_t"]])
+            return rows, None
+        if mode == "dense_cold":
+            # a leaf survives repeated backwards: share it (grads accumulate)
+            if "rows_cached" in stage:
+                return stage["rows_cached"], None
+            rows = Tensor(stage["pack"], stop_gradient=False)
+            stage["rows_cached"] = rows
+            return rows, (stage["order_ids"], rows)
+        rows = Tensor(stage["pack"], stop_gradient=False)
+        return rows, (stage["order_ids"], rows)
+
+    def _consume_prefetch(self, key: bytes):
+        """Find the slot whose NEXT unconsumed sub-batch matches ``key``
+        (prefetch ordering contract: sub-batches are consumed in submission
+        order, so slots staged BEFORE the match were skipped by the caller
+        and are dropped, as are slots a mid-step push marked stale). Slots
+        ahead of the consumer stay queued. No match leaves the queue intact
+        and the pull falls back to synchronous. Returns (slot, inverse)."""
+        if any(s["stale"] for s in self._slots):
+            self._slots = [s for s in self._slots if not s["stale"]]
+        for j, slot in enumerate(self._slots):
+            if slot["keys"][slot["cursor"]] != key:
+                continue
+            if slot["seq"] != self._push_seq:
+                # staged before a later push: wait for that push's patch
+                # pass BEFORE unlisting the slot (the patch pass can only
+                # repair slots it can still see). A slot prefetched after
+                # the push needs no barrier — the worker FIFO ran the push
+                # before its gather.
+                self._await_pushes()
+                if slot["stale"]:
+                    self._slots.remove(slot)
+                    return None
+            # drop skipped older slots; their packs were read-only staging
+            _prof.counter_inc("host_emb_prefetch_drops", j)
+            del self._slots[:j]
+            # waits here land inside forward's host_emb_block_ns window —
+            # no separate counting, or blocking time would be billed twice
+            slot["done"].wait()
+            if slot["err"] is not None:
+                raise RuntimeError("host embedding prefetch failed") from slot["err"]
+            inverse = slot["invs"][slot["cursor"]]
+            slot["cursor"] += 1
+            if slot["cursor"] >= len(slot["keys"]):
+                self._slots.remove(slot)
+            _prof.counter_inc("host_emb_prefetch_hits")
+            return slot, inverse
+        return None
+
+    def _await_pushes(self):
+        """Block until the last async push (and its staged-pack patches)
+        landed — a pull must observe every push submitted before it, exactly
+        like the synchronous path. Callers are inside forward's
+        host_emb_block_ns window; counting here too would double-bill."""
+        ev = self._last_push_done
+        if ev is not None and not ev.is_set():
+            ev.wait()
+
+    # -- forward ------------------------------------------------------------
+    def forward(self, x):
+        self._check_async_err()
+        xt = as_tensor(x)
+        ids = np.ascontiguousarray(np.asarray(_concrete(xt._data)), np.int64)
+        t0 = time.perf_counter_ns()
+        hit = self._consume_prefetch(ids.ravel().tobytes()) if self._slots else None
+        if hit is not None:
+            slot, inverse = hit
+            stage = slot["stage"]
+        else:
+            self._await_pushes()
+            uniq, inverse = _unique(ids.ravel())
+            with self._table_lock:
+                stage = self._build_pack(uniq)
+            if stage["perm"] is not None:
+                inverse = stage["perm"][inverse]
+        _prof.counter_inc("host_emb_lookups", int(ids.size))
+        _prof.counter_inc("host_emb_block_ns", time.perf_counter_ns() - t0)
+        rows, pend = self._stage_to_rows(stage)
+        if self.training and pend is not None:
+            self._pending.append(pend)
+        inv = Tensor(jnp.asarray(inverse.reshape(ids.shape)))
+
+        out = eager_call(
+            "host_embedding_select",
+            lambda r, iv: r[iv],
+            [rows, inv],
+        )
+        return out
+
+    # -- push ---------------------------------------------------------------
+    def apply_gradients(self, lr: float):
+        """Push: apply accumulated sparse grads to the host table. Pending
+        microbatches are COALESCED first — duplicate ids across microbatches
+        merge into one row update (one gather/scatter on the table, and for
+        the sharded table one pull/push round instead of one per microbatch).
+        Under ``FLAGS_host_emb_async_push`` the D2H + merge + scatter run on
+        the PS worker; ordering against later pulls/prefetches is the
+        worker's FIFO, and staged packs the push overlaps are re-gathered."""
+        self._check_async_err()
+        ids_list, grad_list = [], []
+        for order_ids, rows in self._pending:
+            if rows.grad is not None:
+                ids_list.append(order_ids)
+                # keep the lazy/async handle: np.asarray happens at apply
+                # time (worker thread under async push), not here — the
+                # _concrete here only dispatches the pending flush
+                grad_list.append(_concrete(rows.grad._data))
+        self._pending = []
+        # dense-leaf hot half (SGD cache): autograd already coalesced every
+        # microbatch's hot grads onto the buffer; ONE jitted dense update
+        # applies them, device-resident end to end. Runs after the flush
+        # dispatch above, so the grad handle is an async future, and the
+        # trainer pays a single dispatch — counted as PS-blocking time.
+        cache = self.cache
+        if cache is not None and cache.dense and cache.rows_t is not None \
+                and cache.rows_t.grad is not None:
+            t0 = time.perf_counter_ns()
+            with self._table_lock:
+                cache.dense_update(cache.rows_t.grad, lr)
+            _prof.counter_inc("host_emb_block_ns",
+                              time.perf_counter_ns() - t0)
+        if cache is not None and cache._shrink_req:
+            # the all-hot step never reaches _apply_local's check below
+            with self._table_lock:
+                self._maybe_shrink_cache()
+        sharded = isinstance(self.table, ShardedHostEmbeddingTable)
+        if not ids_list and not sharded:
+            return
+        # a SHARDED push is a lockstep collective: a rank with nothing to
+        # push must still participate (empty payload), or peers deadlock in
+        # store.wait() and the _gen counters diverge
+        payload = {"ids_list": ids_list, "grad_list": grad_list, "lr": lr}
+        if (_flags.flag("FLAGS_host_emb_async_push", False) and not sharded):
+            t0 = time.perf_counter_ns()
+            payload["done"] = threading.Event()
+            self._last_push_done = payload["done"]
+            self._push_seq += 1
+            self._ensure_worker().submit("apply", payload)
+            _prof.counter_inc("host_emb_block_ns",
+                              time.perf_counter_ns() - t0)
+            return
+        t0 = time.perf_counter_ns()
+        self._job_apply(payload)
+        _prof.counter_inc("host_emb_block_ns", time.perf_counter_ns() - t0)
+
+    def _job_apply(self, payload):
+        """Apply one coalesced push (trainer thread, or PS worker under
+        async push)."""
+        try:
+            ids_list = payload["ids_list"]
+            grad_list = [np.asarray(g, np.float32) for g in payload["grad_list"]]
+            # drop shape-padding lanes (order_ids == -1, zero grads; pads
+            # sit after each hot/cold segment, not only at the tail)
+            for i, ids_i in enumerate(ids_list):
+                if ids_i.size and (ids_i < 0).any():
+                    keep = ids_i >= 0
+                    ids_list[i] = ids_i[keep]
+                    grad_list[i] = grad_list[i][keep]
+            lr = payload["lr"]
+            dim = self.table.embedding_dim
+            sharded = isinstance(self.table, ShardedHostEmbeddingTable)
+            with _span("host_emb.push",
+                       rows=int(sum(i.size for i in ids_list)) if ids_list else 0):
+                # adagrad's accumulator is step-count sensitive: one update
+                # with the summed grad != one update per microbatch. For a
+                # LOCAL table the coalescing buys nothing (no comm round), so
+                # keep per-microbatch semantics there; the sharded table
+                # coalesces (one pull/push round) and documents the
+                # summed-grad semantics as the distributed contract.
+                if not sharded and getattr(self.table, "optimizer", "sgd") == "adagrad":
+                    with self._table_lock:
+                        for ids_i, grad_i in zip(ids_list, grad_list):
+                            self._apply_local(ids_i, grad_i, lr)
+                    self._patch_slots(np.concatenate(ids_list) if ids_list else None)
+                    return
+                uniq, merged = _merge_sparse_grads(ids_list, grad_list, dim)
+                if uniq.size == 0 and not sharded:
+                    return
+                with self._table_lock:
+                    if sharded:
+                        self.table.apply_update(uniq, merged, lr)
+                    else:
+                        self._apply_local(uniq, merged, lr)
+                self._patch_slots(uniq)
+        finally:
+            ev = payload.get("done")
+            if ev is not None:
+                ev.set()
+
+    def _maybe_shrink_cache(self):
+        """Apply a requested pressure shrink at a PUSH boundary (the dense
+        grad is already consumed) and invalidate staged packs holding the
+        old slot numbering — their consumers fall back to a synchronous
+        pull. Caller holds the table lock."""
+        cache = self.cache
+        if cache is None or not cache._shrink_req:
+            return
+        cache._apply_shrink()
+        for slot in list(self._slots):
+            if slot["stage"] is not None:
+                slot["stale"] = True
+
+    def _apply_local(self, uniq: np.ndarray, merged: np.ndarray, lr: float):
+        """Split one merged update between the device cache (hot rows,
+        updated in place — no PCIe crossing for the rows) and the host
+        table (cold rows, native scatter); then admit newly-frequent ids
+        with their post-update values. Caller holds the table lock."""
+        cache = self.cache
+        if cache is None:
+            self.table.apply_update(uniq, merged, lr)
+            return
+        self._maybe_shrink_cache()
+        hit, slots = cache.lookup(uniq, count_stats=False)
+        nh = int(hit.sum())
+        if nh:
+            cache.update(slots, merged[hit], lr)
+        cold = uniq[~hit]
+        if cold.size:
+            self.table.apply_update(cold, merged[~hit], lr)
+            cand = cache.admission_candidates(cold)
+            if cand.size:
+                rows = self.table.gather(cand)
+                acc = (self.table._accum[cand]
+                       if self.table._accum is not None else None)
+                cache.admit(cand, rows, acc)
+
+    def _patch_slots(self, updated_ids: Optional[np.ndarray]):
+        """A push that lands while later batches' packs are already staged
+        must not leave them stale: re-stage any in-flight slot whose ids
+        intersect the update (frequent ids recur batch-to-batch, so this is
+        the common case, and the re-gather still runs on whichever thread
+        applied the push — off the trainer under async push)."""
+        if updated_ids is None or not self._slots:
+            return
+        upd = np.unique(updated_ids)
+        for slot in list(self._slots):
+            if slot["stage"] is None:
+                continue  # gather still queued: FIFO runs it after this push
+            if np.intersect1d(slot["uniq"], upd, assume_unique=True).size == 0:
+                continue
+            if slot["cursor"] > 0:
+                # partially consumed: earlier sub-batches' tensors already
+                # feed live graphs, so the staging can't be swapped out —
+                # mark stale; the consumer drops it and pulls synchronously
+                slot["stale"] = True
+                continue
+            # value-only patch: refresh just the pushed rows inside the
+            # staged block (hot rows read the live buffer at consume time
+            # and never go stale; membership drift is routed by the push's
+            # live lookup). One small H2D + one jitted row scatter — far
+            # cheaper than a full re-stage; this runs inside the push.
+            stage = slot["stage"]
+            if stage["mode"] == "packed":
+                # adagrad pack: hot and cold interleave in pack order, so a
+                # positional value-patch doesn't apply — rebuild (rare path)
+                with self._table_lock:
+                    stage = self._build_pack(slot["uniq"], pad=True)
+                    slot["stage"] = stage
+                    slot["invs"] = self._split_invs(slot, stage,
+                                                    slot["inverse_u"])
+                _prof.counter_inc("host_emb_prefetch_patched")
+                continue
+            staged_ids = (stage["cold_ids"] if stage["mode"] == "dense"
+                          else stage["order_ids"])
+            if staged_ids is None:
+                continue  # hot-only stage: nothing host-backed to refresh
+            valid = staged_ids[staged_ids >= 0]  # sorted (uniq order)
+            isect = np.intersect1d(valid, upd, assume_unique=True)
+            if isect.size == 0:
+                continue
+            with self._table_lock:
+                # positions within the staged block; -1 pads sit after the
+                # valid prefix in every mode's id vector
+                base = np.searchsorted(valid, isect)
+                rows = None
+                if self.cache is not None and self.cache._ids_sorted.size:
+                    # ids staged COLD but cache members by now (admitted by
+                    # this or an earlier push) have their AUTHORITATIVE copy
+                    # on the device — the host row goes stale after the
+                    # next device-side update — so refresh those from the
+                    # cache buffer and only the rest from the host table
+                    srt = self.cache._ids_sorted
+                    p = np.minimum(np.searchsorted(srt, isect), srt.size - 1)
+                    member = srt[p] == isect
+                    if member.any():
+                        rows = np.empty((isect.size, self.table.embedding_dim),
+                                        np.float32)
+                        buf = np.asarray(self.cache._rows)
+                        rows[member] = buf[self.cache._slots_sorted[p[member]]]
+                        if (~member).any():
+                            rows[~member] = self.table.gather(isect[~member])
+                if rows is None:
+                    rows = self.table.gather(isect)
+                pl = self._bucket("patch", isect.size)
+                buf_len = int((stage["cold_dev"] if stage["mode"] == "dense"
+                               else stage["pack"]).shape[0])
+                # pad sentinel = one past the end: dropped by mode="drop",
+                # and small enough to survive XLA's int32 index cast (a
+                # huge sentinel would wrap and corrupt row 0)
+                pos = np.full(pl, buf_len, np.int64)
+                pos[: isect.size] = base
+                vals = np.zeros((pl, self.table.embedding_dim), np.float32)
+                vals[: isect.size] = rows
+                if stage["mode"] == "dense":
+                    stage["cold_dev"] = _jit_row_set(
+                        stage["cold_dev"], jnp.asarray(pos), jnp.asarray(vals))
+                else:
+                    stage["pack"] = _jit_row_set(
+                        stage["pack"], jnp.asarray(pos), jnp.asarray(vals))
+            _prof.counter_inc("host_emb_prefetch_patched")
+
+    def embedding_dim(self):
+        return self.table.embedding_dim
+
+
+# per-process construction counter: ranks build their tables in the same
+# program order, so the index is a deterministic cross-rank identity
+_instance_lock = threading.Lock()
+_instance_count = 0  # guarded_by: _instance_lock
 
 
 class ShardedHostEmbeddingTable:
@@ -202,29 +1386,34 @@ class ShardedHostEmbeddingTable:
     grads to the owners, which merge duplicate ids and apply ONE sparse
     update — sync-PS semantics, deterministic regardless of sharding.
 
-    Transport chunks rows through the store in ≤512 KB messages; per-row
-    deterministic lazy init means a row's value is identical no matter which
-    shard materializes it.
+    Transport: each (src, dst) exchange is ONE coalesced payload (push
+    packs ids + grads together) split into ``FLAGS_host_emb_chunk_bytes``
+    store messages moved by a pool of ``FLAGS_host_emb_transport_threads``
+    dedicated store connections in parallel (the pre-PR path was one
+    serial ≤512 KiB round trip at a time). ``FLAGS_host_emb_push_fp16``
+    sends push grads as float16 (half the bytes; lossy, opt-in). Per-row
+    deterministic lazy init means a row's value is identical no matter
+    which shard materializes it.
     """
-
-    CHUNK = 512 * 1024
-    # per-process construction counter: ranks build their tables in the same
-    # program order, so the index is a deterministic cross-rank identity
-    _instance_counter = 0
 
     def __init__(self, num_embeddings, embedding_dim, store, rank, world_size,
                  dtype="float32", path=None, init_std=0.01, seed=0,
-                 optimizer="sgd", adagrad_eps=1e-8, name=None):
+                 optimizer="sgd", adagrad_eps=1e-8, name=None, store_addr=None):
+        global _instance_count
         self.num_embeddings = int(num_embeddings)
         self.embedding_dim = int(embedding_dim)
         self.rank = int(rank)
         self.world_size = int(world_size)
         self.store = store
+        self.store_addr = store_addr
         # namespace every store key by table identity: two tables sharing one
         # TCPStore each count gens from 0, and without this a fast rank's
-        # table-2 request could be consumed as a peer's table-1 traffic
-        idx = ShardedHostEmbeddingTable._instance_counter
-        ShardedHostEmbeddingTable._instance_counter += 1
+        # table-2 request could be consumed as a peer's table-1 traffic.
+        # Two THREADS constructing tables concurrently must also get distinct
+        # indices, or their tables would collide on one store namespace.
+        with _instance_lock:
+            idx = _instance_count
+            _instance_count += 1
         self.name = name if name is not None else f"t{idx}"
         self._prefix = f"he/{self.name}"
         # local shard holds global ids {rank, rank+world, rank+2*world, …}
@@ -240,6 +1429,7 @@ class ShardedHostEmbeddingTable:
         self._seed = int(seed)
         self._std = float(init_std)
         self._gen = 0
+        self._pool = None  # lazily-built parallel transport (client pool)
 
     def _ensure_init_local(self, local_ids: np.ndarray):
         t = self.local
@@ -251,21 +1441,94 @@ class ShardedHostEmbeddingTable:
             global_ids, t.embedding_dim, self._seed, self._std
         ).astype(t.dtype)
         t._initialized[fresh] = True
+        t._n_initialized += int(fresh.size)
 
     # -- store transport ---------------------------------------------------
+    @property
+    def CHUNK(self) -> int:
+        return int(_flags.flag("FLAGS_host_emb_chunk_bytes", 4 * 1024 * 1024)
+                   or 512 * 1024)
+
+    def _transport(self):
+        """(clients, executors) for parallel chunk transport, or None for
+        the serial path (no endpoint known / threads disabled). Each worker
+        owns ONE dedicated connection — a TCPStore client is a single
+        socket, and interleaving two requests on it would corrupt both."""
+        nthreads = int(_flags.flag("FLAGS_host_emb_transport_threads", 4) or 0)
+        if self._pool is None and nthreads > 0 and self.store_addr is not None:
+            try:
+                from concurrent.futures import ThreadPoolExecutor
+                from ..core.native import TCPStore
+
+                host, port = self.store_addr
+                clients = [TCPStore(host=host, port=port, is_master=False)
+                           for _ in range(nthreads)]
+                execs = [ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix=f"he-tx{i}")
+                         for i in range(nthreads)]
+                self._pool = (clients, execs)
+            except Exception:
+                self._pool = False  # endpoint unusable: stay serial
+        return self._pool or None
+
     def _put(self, key: str, payload: bytes):
-        n = (len(payload) + self.CHUNK - 1) // self.CHUNK or 1
-        for i in range(n):
-            self.store.set(f"{key}/{i}", payload[i * self.CHUNK:(i + 1) * self.CHUNK])
+        chunk = self.CHUNK
+        n = (len(payload) + chunk - 1) // chunk or 1
+        pool = self._transport() if n > 1 else None
+        if pool is None:
+            for i in range(n):
+                self.store.set(f"{key}/{i}", payload[i * chunk:(i + 1) * chunk])
+        else:
+            clients, execs = pool
+            futs = [
+                execs[i % len(execs)].submit(
+                    clients[i % len(clients)].set, f"{key}/{i}",
+                    payload[i * chunk:(i + 1) * chunk])
+                for i in range(n)
+            ]
+            for f in futs:
+                f.result()
         self.store.set(key + "/n", str(n))
 
     def _take(self, key: str) -> bytes:
+        chunk = self.CHUNK
         n = int(self.store.wait(key + "/n"))
-        parts = [self.store.wait(f"{key}/{i}") for i in range(n)]
-        for i in range(n):
-            self.store.delete_key(f"{key}/{i}")
+        pool = self._transport() if n > 1 else None
+        if pool is None:
+            parts = [self.store.wait(f"{key}/{i}", max_bytes=chunk + 64)
+                     for i in range(n)]
+            for i in range(n):
+                self.store.delete_key(f"{key}/{i}")
+        else:
+            clients, execs = pool
+
+            def fetch(i):
+                c = clients[i % len(clients)]
+                part = c.wait(f"{key}/{i}", max_bytes=chunk + 64)
+                c.delete_key(f"{key}/{i}")
+                return part
+
+            futs = [execs[i % len(execs)].submit(fetch, i) for i in range(n)]
+            parts = [f.result() for f in futs]
         self.store.delete_key(key + "/n")
         return b"".join(parts)
+
+    # push payloads coalesce ids + grads into one message:
+    #   u64 n_ids | u8 fp16 | ids (n*8B) | grads (n*dim*4B or *2B)
+    def _pack_push(self, ids: np.ndarray, grad: np.ndarray) -> bytes:
+        fp16 = bool(_flags.flag("FLAGS_host_emb_push_fp16", False))
+        g = np.ascontiguousarray(grad, np.float16 if fp16 else np.float32)
+        return (struct.pack("<QB", ids.size, int(fp16))
+                + np.ascontiguousarray(ids, np.int64).tobytes() + g.tobytes())
+
+    def _unpack_push(self, payload: bytes):
+        n, fp16 = struct.unpack_from("<QB", payload)
+        off = 9
+        ids = np.frombuffer(payload, np.int64, count=n, offset=off)
+        off += n * 8
+        dt = np.float16 if fp16 else np.float32
+        grad = np.frombuffer(payload, dt, offset=off).reshape(-1, self.embedding_dim)
+        return ids, np.ascontiguousarray(grad, np.float32)
 
     # -- collective pull ---------------------------------------------------
     def gather(self, ids: np.ndarray) -> np.ndarray:
@@ -277,28 +1540,29 @@ class ShardedHostEmbeddingTable:
         self._gen += 1
         owner = ids % self.world_size
         out = np.empty((ids.size, self.embedding_dim), np.float32)
-        # 1. send requests (own ids resolve locally)
-        for o in range(self.world_size):
-            if o == self.rank:
-                continue
-            want = ids[owner == o]
-            self._put(f"{self._prefix}/{gen}/req/{self.rank}/{o}", want.tobytes())
-        mine = ids[owner == self.rank]
-        if mine.size:
-            out[owner == self.rank] = self.local.gather(mine // self.world_size)
-        # 2. serve every other rank's request against the local shard
-        for r in range(self.world_size):
-            if r == self.rank:
-                continue
-            req = np.frombuffer(self._take(f"{self._prefix}/{gen}/req/{r}/{self.rank}"), np.int64)
-            rows = self.local.gather(req // self.world_size) if req.size else np.empty((0, self.embedding_dim), np.float32)
-            self._put(f"{self._prefix}/{gen}/rep/{self.rank}/{r}", np.ascontiguousarray(rows, np.float32).tobytes())
-        # 3. read replies
-        for o in range(self.world_size):
-            if o == self.rank:
-                continue
-            rows = np.frombuffer(self._take(f"{self._prefix}/{gen}/rep/{o}/{self.rank}"), np.float32)
-            out[owner == o] = rows.reshape(-1, self.embedding_dim)
+        with _span("host_emb.shard_pull", rows=int(ids.size)):
+            # 1. send requests (own ids resolve locally)
+            for o in range(self.world_size):
+                if o == self.rank:
+                    continue
+                want = ids[owner == o]
+                self._put(f"{self._prefix}/{gen}/req/{self.rank}/{o}", want.tobytes())
+            mine = ids[owner == self.rank]
+            if mine.size:
+                out[owner == self.rank] = self.local.gather(mine // self.world_size)
+            # 2. serve every other rank's request against the local shard
+            for r in range(self.world_size):
+                if r == self.rank:
+                    continue
+                req = np.frombuffer(self._take(f"{self._prefix}/{gen}/req/{r}/{self.rank}"), np.int64)
+                rows = self.local.gather(req // self.world_size) if req.size else np.empty((0, self.embedding_dim), np.float32)
+                self._put(f"{self._prefix}/{gen}/rep/{self.rank}/{r}", _c_f32(rows).tobytes())
+            # 3. read replies
+            for o in range(self.world_size):
+                if o == self.rank:
+                    continue
+                rows = np.frombuffer(self._take(f"{self._prefix}/{gen}/rep/{o}/{self.rank}"), np.float32)
+                out[owner == o] = rows.reshape(-1, self.embedding_dim)
         return out
 
     # -- collective push ---------------------------------------------------
@@ -310,139 +1574,39 @@ class ShardedHostEmbeddingTable:
         gen = self._gen
         self._gen += 1
         owner = ids % self.world_size
-        for o in range(self.world_size):
-            if o == self.rank:
-                continue
-            sel = owner == o
-            self._put(f"{self._prefix}/{gen}/gid/{self.rank}/{o}", ids[sel].tobytes())
-            self._put(f"{self._prefix}/{gen}/g/{self.rank}/{o}", np.ascontiguousarray(grad[sel]).tobytes())
-        all_ids = [ids[owner == self.rank]]
-        all_grads = [grad[owner == self.rank]]
-        for r in range(self.world_size):
-            if r == self.rank:
-                continue
-            gi = np.frombuffer(self._take(f"{self._prefix}/{gen}/gid/{r}/{self.rank}"), np.int64)
-            gg = np.frombuffer(self._take(f"{self._prefix}/{gen}/g/{r}/{self.rank}"), np.float32).reshape(-1, self.embedding_dim)
-            all_ids.append(gi)
-            all_grads.append(gg)
-        uniq, merged = _merge_sparse_grads(all_ids, all_grads, self.embedding_dim)
-        if uniq.size == 0:
-            return
-        self.local.apply_update(uniq // self.world_size, merged, lr)
+        with _span("host_emb.shard_push", rows=int(ids.size)):
+            for o in range(self.world_size):
+                if o == self.rank:
+                    continue
+                sel = owner == o
+                payload = self._pack_push(ids[sel], grad[sel])
+                # PUSH bytes only: pull req/rep traffic through the same
+                # transport must not dilute the EQuARX-motivated metric
+                _prof.counter_inc("host_emb_push_bytes", len(payload))
+                self._put(f"{self._prefix}/{gen}/push/{self.rank}/{o}",
+                          payload)
+            all_ids = [ids[owner == self.rank]]
+            all_grads = [grad[owner == self.rank]]
+            for r in range(self.world_size):
+                if r == self.rank:
+                    continue
+                gi, gg = self._unpack_push(
+                    self._take(f"{self._prefix}/{gen}/push/{r}/{self.rank}"))
+                all_ids.append(gi)
+                all_grads.append(gg)
+            uniq, merged = _merge_sparse_grads(all_ids, all_grads, self.embedding_dim)
+            if uniq.size == 0:
+                return
+            self.local.apply_update(uniq // self.world_size, merged, lr)
 
-
-class HostEmbedding(Layer):
-    """Embedding layer over a HostEmbeddingTable.
-
-    Eager-mode by design: the gather crosses the host boundary, exactly like
-    the reference's PS pull — the dense model around it can still run
-    compiled. Call ``apply_gradients(lr)`` after ``backward()`` (the role of
-    the PS push / SelectedRows optimizer)."""
-
-    def __init__(self, num_embeddings, embedding_dim, path=None, optimizer="sgd",
-                 init_std=0.01, seed=0, sparse=True, name=None, table=None):
-        super().__init__()
-        # table=ShardedHostEmbeddingTable(...) makes this layer the worker
-        # side of a multi-process PS (fleet wires this up from env)
-        self.table = table or HostEmbeddingTable(
-            num_embeddings, embedding_dim, path=path, optimizer=optimizer,
-            init_std=init_std, seed=seed,
-        )
-        self._pending = []  # (unique_ids, rows_tensor) awaiting push
-        self._prefetched = None  # (uniq_key_bytes, rows ndarray, thread)
-        import threading
-
-        # one lock serializes table reads (prefetch thread) against the
-        # sparse updates (apply_gradients) — torn rows are silent corruption
-        self._table_lock = threading.Lock()
-
-    def prefetch(self, x):
-        """Start the host gather for the NEXT batch on a worker thread so it
-        overlaps the current device step (the reference's PS prefetch /
-        buffered pull). forward() consumes the result when ids match.
-
-        No-op on a SHARDED table: its gather is a lockstep collective across
-        ranks, and an extra/mismatched gather from a background thread would
-        desynchronize the exchange protocol."""
-        import threading
-
-        if isinstance(self.table, ShardedHostEmbeddingTable):
-            return
-        ids = np.asarray(x._data if isinstance(x, Tensor) else x).astype(np.int64)
-        uniq = np.unique(ids.ravel())
-        slot = {"key": uniq.tobytes(), "rows": None}
-
-        def work():
-            with self._table_lock:
-                slot["rows"] = self.table.gather(uniq)
-
-        th = threading.Thread(target=work, daemon=True)
-        th.start()
-        self._prefetched = (slot, th)
-
-    def _gather(self, uniq: np.ndarray) -> np.ndarray:
-        if self._prefetched is not None:
-            slot, th = self._prefetched
-            th.join()
-            self._prefetched = None
-            if slot["key"] == uniq.tobytes():
-                return slot["rows"]
-        with self._table_lock:
-            return self.table.gather(uniq)
-
-    def forward(self, x):
-        xt = as_tensor(x)
-        ids = np.asarray(_concrete(xt._data)).astype(np.int64)
-        uniq, inverse = np.unique(ids.ravel(), return_inverse=True)
-        rows = Tensor(jnp.asarray(self._gather(uniq)), stop_gradient=False)
-        if self.training:
-            self._pending.append((uniq, rows))
-        inv = Tensor(jnp.asarray(inverse.reshape(ids.shape)))
-
-        out = eager_call(
-            "host_embedding_select",
-            lambda r, iv: r[iv],
-            [rows, inv],
-        )
-        return out
-
-    def apply_gradients(self, lr: float):
-        """Push: apply accumulated sparse grads to the host table. Pending
-        microbatches are COALESCED first — duplicate ids across microbatches
-        merge into one row update (one gather/scatter on the table, and for
-        the sharded table one pull/push round instead of one per microbatch)."""
-        ids_list, grad_list = [], []
-        for uniq, rows in self._pending:
-            if rows.grad is not None:
-                ids_list.append(uniq)
-                grad_list.append(np.asarray(_concrete(rows.grad._data), np.float32))
-        self._pending = []
-        sharded = isinstance(self.table, ShardedHostEmbeddingTable)
-        if not ids_list and not sharded:
-            return
-        # a SHARDED push is a lockstep collective: a rank with nothing to
-        # push must still participate (empty payload), or peers deadlock in
-        # store.wait() and the _gen counters diverge
-        dim = self.table.embedding_dim
-        # adagrad's accumulator is step-count sensitive: one update with the
-        # summed grad != one update per microbatch. For a LOCAL table the
-        # coalescing buys nothing (no comm round), so keep per-microbatch
-        # semantics there; the sharded table coalesces (one pull/push round)
-        # and documents the summed-grad semantics as the distributed contract.
-        if not sharded and getattr(self.table, "optimizer", "sgd") == "adagrad":
-            with self._table_lock:
-                for ids_i, grad_i in zip(ids_list, grad_list):
-                    self.table.apply_update(ids_i, grad_i, lr)
-            self._prefetched = None
-            return
-        uniq, merged = _merge_sparse_grads(ids_list, grad_list, dim)
-        if uniq.size == 0 and not sharded:
-            return
-        with self._table_lock:
-            self.table.apply_update(uniq, merged, lr)
-        # rows prefetched BEFORE this update are stale now (frequent ids
-        # recur batch-to-batch); drop them so forward re-gathers fresh rows
-        self._prefetched = None
-
-    def embedding_dim(self):
-        return self.table.embedding_dim
+    def close(self):
+        if self._pool:
+            clients, execs = self._pool
+            for e in execs:
+                e.shutdown(wait=False)
+            for c in clients:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            self._pool = None

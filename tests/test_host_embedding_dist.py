@@ -112,6 +112,132 @@ class TestShardedHostEmbedding:
         np.testing.assert_allclose(outs[0]["losses"], ref, rtol=1e-5)
 
 
+CHUNK_WORKER = textwrap.dedent(
+    """
+    import os, json
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import flags
+    from paddle_tpu.incubate.host_embedding import sharded_host_embedding
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    # tiny chunks force the multi-chunk parallel transport on every
+    # exchange; fp16 push halves the grad payload when armed
+    flags.set_flags({"FLAGS_host_emb_chunk_bytes": 4096,
+                     "FLAGS_host_emb_transport_threads": 3,
+                     "FLAGS_host_emb_push_fp16":
+                         os.environ.get("HE_FP16", "0") == "1"})
+    emb = sharded_host_embedding(512, 16, seed=3)
+    steps = []
+    for step in range(3):
+        rng = np.random.RandomState(200 + step)
+        ids = rng.randint(0, 512, (8, 32))  # 256 ids/step >> chunk
+        out = emb(paddle.to_tensor(ids))
+        loss = paddle.sum(out * out)
+        loss.backward()
+        emb.apply_gradients(lr=0.1)
+        steps.append(float(loss.numpy()))
+    from paddle_tpu import profiler
+    c = profiler.counters()
+    print(json.dumps({"rank": rank, "losses": steps,
+                      "push_bytes": c.get("host_emb_push_bytes", 0)}), flush=True)
+    """
+)
+
+
+def _run_world(worker, world=2, extra_env=None, timeout=240):
+    port = _free_port()
+    procs = []
+    for rank in range(world):
+        env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS",)}
+        env.update({
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_EMB_STORE_PORT": str(port),
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", worker],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        ))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, err.decode()[-2000:]
+        outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+    return outs
+
+
+class TestChunkParallelTransport:
+    def _single_proc_reference(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.host_embedding import HostEmbedding
+
+        emb = HostEmbedding(512, 16, seed=3)
+        ref = []
+        for step in range(3):
+            rng = np.random.RandomState(200 + step)
+            ids = rng.randint(0, 512, (8, 32))
+            out = emb(paddle.to_tensor(ids))
+            loss = paddle.sum(out * out)
+            loss.backward()
+            for uniq, rows in emb._pending:
+                if rows.grad is not None:
+                    rows.grad._set_data(rows.grad._data * 2.0)
+            emb.apply_gradients(lr=0.1)
+            ref.append(float(loss.numpy()))
+        return ref
+
+    def test_two_proc_parity_with_parallel_chunks(self):
+        from paddle_tpu.core.native import lib
+
+        if lib() is None:
+            pytest.skip("native runtime not built")
+        outs = _run_world(CHUNK_WORKER, world=2)
+        assert outs[0]["losses"] == outs[1]["losses"], outs
+        # the coalesced push payloads were actually counted
+        assert outs[0]["push_bytes"] > 0
+        np.testing.assert_allclose(
+            outs[0]["losses"], self._single_proc_reference(), rtol=1e-5)
+
+    def test_fp16_push_close_but_half_bytes(self):
+        from paddle_tpu.core.native import lib
+
+        if lib() is None:
+            pytest.skip("native runtime not built")
+        outs32 = _run_world(CHUNK_WORKER, world=2)
+        outs16 = _run_world(CHUNK_WORKER, world=2, extra_env={"HE_FP16": "1"})
+        assert outs16[0]["losses"] == outs16[1]["losses"]
+        # lossy but close; payload bytes drop (ids stay 8B, grads 4B -> 2B)
+        np.testing.assert_allclose(
+            outs16[0]["losses"], outs32[0]["losses"], rtol=2e-2)
+        assert outs16[0]["push_bytes"] < outs32[0]["push_bytes"]
+
+
+class TestInstanceCounterThreadSafety:
+    def test_concurrent_construction_distinct_namespaces(self):
+        import threading
+        from paddle_tpu.incubate.host_embedding import ShardedHostEmbeddingTable
+
+        names = []
+        lock = threading.Lock()
+
+        def build():
+            t = ShardedHostEmbeddingTable(64, 4, store=None, rank=0, world_size=2)
+            with lock:
+                names.append(t.name)
+
+        threads = [threading.Thread(target=build) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(names)) == 16, f"colliding table namespaces: {names}"
+
+
 class TestCoalescedPush:
     def test_duplicate_ids_across_microbatches_merge(self):
         import paddle_tpu as paddle
