@@ -96,6 +96,24 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_serve_prefill_batch": 4,
     "FLAGS_serve_max_seq_len": 2048,
     "FLAGS_serve_int8": False,
+    # Serving throughput multipliers (PR 16). FLAGS_serve_prefix_cache keeps
+    # retired prompts' KV blocks in a refcounted prefix index so admission
+    # can match the longest cached prefix (chained block-granularity hashes
+    # over prompt token chunks) and prefill only the tail.
+    # FLAGS_serve_spec_k > 0 arms speculative decoding: a drafter proposes k
+    # tokens per step and the target model verifies all k in ONE batched
+    # paged-decode step, accepting the longest agreeing prefix (greedy
+    # output stays bit-identical to non-speculative decode).
+    # FLAGS_serve_drafter picks the proposer: "ngram" (host-side prompt
+    # lookup, no extra model) — a small same-family model can be passed to
+    # Engine(drafter=...) directly. FLAGS_serve_draft_window bounds the
+    # model drafter's dense attention window in tokens. Both features
+    # default OFF and their code paths are never reached unconfigured
+    # (pinned by the inert tripwire in tests/test_serving_prefix.py).
+    "FLAGS_serve_prefix_cache": False,
+    "FLAGS_serve_spec_k": 0,
+    "FLAGS_serve_drafter": "ngram",
+    "FLAGS_serve_draft_window": 64,
     # Serving resilience (serving/engine.py + serving/supervisor.py).
     # FLAGS_serve_max_queue sets the queue depth at which the shed policy
     # engages (0 = never); it is only enforced when FLAGS_serve_shed is ALSO

@@ -118,6 +118,39 @@ def _gpt_arch(H, D):
         rows = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
         return rows @ params["wte"].T
 
+    def head_all(params, x):
+        # logits at EVERY fed position (speculative verify reads all k+1)
+        return _ln(x, params["lnf_w"], params["lnf_b"]) @ params["wte"].T
+
+    def embed_tail(params, ids, starts):
+        # T tokens per row at per-row absolute positions starts + [0..T)
+        T = ids.shape[1]
+        pos = starts[:, None] + jnp.arange(T)[None, :]
+        return params["wte"][ids] + params["wpe"][pos]
+
+    def block_tail(w, x, k_ctx, v_ctx, live, starts):
+        # multi-token packed pass against a gathered paged context: x
+        # (B,T,H·D) holds T consecutive tokens per row starting at absolute
+        # position starts (B,); their fresh K/V overwrite the in-context
+        # slots starts+[0..T) before attention (the joint causal pass over
+        # the feeds — token j attends to the fresh K/V of tokens <= j plus
+        # the cached context), live (B,T,Tp) masks per (row, feed). The
+        # caller scatters (k_new, v_new) (B,T,KV,D) back into the pool.
+        B, T = x.shape[0], x.shape[1]
+        rows = jnp.arange(B)[:, None]
+        posm = starts[:, None] + jnp.arange(T)[None, :]
+        h = _ln(x, w["ln1_w"], w["ln1_b"])
+        qkv = (h @ w["qkv_w"] + w["qkv_b"]).reshape(B, T, 3, H, D)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_new, v_new = k, v
+        kc = k_ctx.at[rows, posm].set(k_new)
+        vc = v_ctx.at[rows, posm].set(v_new)
+        o = _grouped_attention(q, kc, vc, live[:, None, None], rep=1)
+        x = x + (o @ w["proj_w"] + w["proj_b"])
+        h2 = _ln(x, w["ln2_w"], w["ln2_b"])
+        ff = jax.nn.gelu(h2 @ w["up_w"] + w["up_b"], approximate=True) @ w["down_w"] + w["down_b"]
+        return x + ff, k_new, v_new
+
     def block_rows(w, x, k_ctx, v_ctx, live, pos):
         # single-token decode against a GATHERED paged context: x (B,1,H·D);
         # k_ctx/v_ctx (B,Tp,KV,D) hold each row's blocks in sequence order
@@ -164,7 +197,8 @@ def _gpt_arch(H, D):
 
     return {"embed_prompt": embed_prompt, "embed_token": embed_token,
             "embed_rows": embed_rows, "head_rows": head_rows,
-            "block_rows": block_rows,
+            "head_all": head_all, "embed_tail": embed_tail,
+            "block_rows": block_rows, "block_tail": block_tail,
             "block": block, "head": head, "kv_heads": H, "head_dim": D}
 
 
@@ -218,6 +252,20 @@ def _rope_rows(x, pos, theta):
     return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
 
 
+def _rope_grid(x, pos, theta):
+    """Rotary embedding at a per-(row, token) position grid (tail prefill /
+    speculative verify): x (B, T, H, D), pos (B, T) int."""
+    D = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    ang = pos.astype(jnp.float32)[:, :, None] * inv[None, None, :]  # (B,T,D/2)
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+
 def _llama_arch(H, KV, D, theta, eps):
     rep = H // KV
 
@@ -234,6 +282,33 @@ def _llama_arch(H, KV, D, theta, eps):
         h = _rms(x, params["lnf_w"], eps)
         rows = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
         return rows @ params["head_w"]
+
+    def head_all(params, x):
+        return _rms(x, params["lnf_w"], eps) @ params["head_w"]
+
+    def embed_tail(params, ids, starts):
+        return params["wte"][ids]
+
+    def block_tail(w, x, k_ctx, v_ctx, live, starts):
+        # see the GPT plug for the contract; RoPE at each (row, feed)'s own
+        # absolute position, GQA against the un-repeated gathered cache
+        B, T = x.shape[0], x.shape[1]
+        rows = jnp.arange(B)[:, None]
+        posm = starts[:, None] + jnp.arange(T)[None, :]
+        h = _rms(x, w["ln1_w"], eps)
+        q = (h @ w["q_w"]).reshape(B, T, H, D)
+        k = (h @ w["k_w"]).reshape(B, T, KV, D)
+        v = (h @ w["v_w"]).reshape(B, T, KV, D)
+        q = _rope_grid(q, posm, theta)
+        k = _rope_grid(k, posm, theta)
+        k_new, v_new = k, v
+        kc = k_ctx.at[rows, posm].set(k_new)
+        vc = v_ctx.at[rows, posm].set(v_new)
+        o = _grouped_attention(q, kc, vc, live[:, None, None], rep)
+        x = x + o @ w["o_w"]
+        h2 = _rms(x, w["ln2_w"], eps)
+        ff = (jax.nn.silu(h2 @ w["gate_w"]) * (h2 @ w["up_w"])) @ w["down_w"]
+        return x + ff, k_new, v_new
 
     def block_rows(w, x, k_ctx, v_ctx, live, pos):
         # see the GPT plug for the contract; RoPE applied at each row's own
@@ -284,7 +359,8 @@ def _llama_arch(H, KV, D, theta, eps):
 
     return {"embed_prompt": embed_prompt, "embed_token": embed_token,
             "embed_rows": embed_rows, "head_rows": head_rows,
-            "block_rows": block_rows,
+            "head_all": head_all, "embed_tail": embed_tail,
+            "block_rows": block_rows, "block_tail": block_tail,
             "block": block, "head": head, "kv_heads": KV, "head_dim": D}
 
 
@@ -647,10 +723,18 @@ def build_paged_decode(arch, B, block_size, max_blocks):
         bids = jnp.take_along_axis(tables, (pos // block_size)[:, None], axis=1)[:, 0]
         offs = pos % block_size
         live = jnp.arange(T_pad)[None, :] <= pos[:, None]
+        # all context gathers hoisted above the scatter chain: layer li's
+        # gather reads kpool[li], which scatters to layers < li never touch,
+        # so the values are identical — but with gathers interleaved, every
+        # scatter's operand has a later reader and XLA copy-on-writes the
+        # whole pool per layer (CPU: ~L pool-sized temps per step); hoisted,
+        # only the first scatter pays one copy
+        ctx = [(kpool[li][tables].reshape(B, T_pad, KV, D),
+                vpool[li][tables].reshape(B, T_pad, KV, D))
+               for li in range(len(layer_ws))]
         for li, w in enumerate(layer_ws):
-            k_ctx = kpool[li][tables].reshape(B, T_pad, KV, D)
-            v_ctx = vpool[li][tables].reshape(B, T_pad, KV, D)
-            x, k_new, v_new = arch["block_rows"](w, x, k_ctx, v_ctx, live, pos)
+            x, k_new, v_new = arch["block_rows"](w, x, ctx[li][0], ctx[li][1],
+                                                 live, pos)
             kpool = kpool.at[li, bids, offs].set(k_new)
             vpool = vpool.at[li, bids, offs].set(v_new)
         logits = arch["head"](params, x)
@@ -661,3 +745,156 @@ def build_paged_decode(arch, B, block_size, max_blocks):
         return kpool, vpool, nxt
 
     return step
+
+
+def build_paged_tail_prefill(arch, B, T_bucket, block_size, max_blocks):
+    """Prefix-cache tail prefill: prompt heads already live in shared pool
+    blocks, only the TAIL tokens run the forward pass.
+
+    The returned pure fn
+    ``prefill(params, ids, starts, lens, tables, kpool, vpool)`` feeds each
+    row's tail ``ids`` (B, T_bucket, padded) at absolute positions
+    ``starts + [0..T)`` (``starts`` is the cached token count, a multiple of
+    ``block_size``), gathers the full context from the block table exactly
+    like decode, overwrites the tail's in-context slots with fresh K/V
+    before the joint causal attention (so tail token j sees the cached
+    prefix plus tail tokens <= j — the batched pass is mathematically the
+    sequential one), scatters the tail's blocks into the pool at table
+    columns ``starts//block_size + j``, and returns ``(kpool, vpool,
+    logits)`` at each row's true last tail token (``lens - 1``). Shared
+    prefix blocks sit BELOW every written column, so a sharer's tail
+    prefill can never touch a peer's mapped block. Rows whose tail bucket
+    overshoots the table (or padding rows) write to the trash block."""
+    KV, D = arch["kv_heads"], arch["head_dim"]
+    if T_bucket % block_size:
+        raise ValueError(
+            f"tail-prefill bucket {T_bucket} must be a multiple of "
+            f"block_size {block_size}"
+        )
+    nb = T_bucket // block_size
+    T_pad = block_size * max_blocks
+
+    def prefill(params, ids, starts, lens, tables, kpool, vpool):
+        layer_ws = params["layers"]
+        x = arch["embed_tail"](params, ids, starts)
+        posm = starts[:, None] + jnp.arange(T_bucket)[None, :]  # (B, T)
+        live = jnp.arange(T_pad)[None, None, :] <= posm[:, :, None]  # (B,T,Tp)
+        cols = (starts // block_size)[:, None] + jnp.arange(nb)[None, :]
+        bids = jnp.take_along_axis(
+            tables, jnp.minimum(cols, max_blocks - 1), axis=1)
+        bids = jnp.where(cols < max_blocks, bids, 0)  # 0 = trash block
+        # gathers hoisted above the scatter chain (see build_paged_decode):
+        # avoids a whole-pool copy-on-write per layer
+        ctx = [(kpool[li][tables].reshape(B, T_pad, KV, D),
+                vpool[li][tables].reshape(B, T_pad, KV, D))
+               for li in range(len(layer_ws))]
+        for li, w in enumerate(layer_ws):
+            x, k_new, v_new = arch["block_tail"](w, x, ctx[li][0], ctx[li][1],
+                                                 live, starts)
+            kpool = kpool.at[li, bids].set(
+                k_new.reshape(B, nb, block_size, KV, D))
+            vpool = vpool.at[li, bids].set(
+                v_new.reshape(B, nb, block_size, KV, D))
+        logits = arch["head_rows"](params, x, lens - 1)
+        return kpool, vpool, logits
+
+    return prefill
+
+
+def build_paged_spec_decode(arch, B, k, block_size, max_blocks):
+    """Speculative verify: ONE batched paged-decode step that feeds k+1
+    tokens per row — the row's pending next-input token followed by k
+    drafted tokens — and returns the target model's greedy continuation at
+    EVERY fed position.
+
+    The returned pure fn
+    ``step(params, kpool, vpool, tables, pos, toks, temps, key)`` takes
+    ``toks`` (B, k+1) fed at absolute positions ``pos + [0..k]``, gathers
+    the paged context, overwrites the k+1 in-context slots with fresh K/V
+    before the joint causal attention (feed j attends to the cache plus
+    feeds <= j, so position j's logits are exactly what j sequential decode
+    steps would produce — the bit-identity guarantee), scatters all k+1
+    fresh K/V into the pool, and returns ``(kpool, vpool, greedy, sampled)``
+    with ``greedy`` (B, k+1) argmax rows and ``sampled`` (B,) drawn from the
+    j=0 logits at ``temps`` (sampling rows accept no drafts; their one
+    token per step matches plain decode's behavior). The host accepts the
+    longest prefix where ``greedy[:, j-1] == toks[:, j]`` and emits
+    ``greedy[:, :m+1]`` — K/V written for rejected feeds is dead weight
+    the next step's feeds overwrite before any read (position p only
+    becomes attendable by a LATER feed, which re-writes slot p first)."""
+    KV, D = arch["kv_heads"], arch["head_dim"]
+    T = k + 1
+    T_pad = block_size * max_blocks
+
+    def step(params, kpool, vpool, tables, pos, toks, temps, key):
+        layer_ws = params["layers"]
+        x = arch["embed_tail"](params, toks, pos)
+        posm = pos[:, None] + jnp.arange(T)[None, :]  # (B, k+1)
+        live = jnp.arange(T_pad)[None, None, :] <= posm[:, :, None]
+        cols = posm // block_size
+        bids = jnp.take_along_axis(
+            tables, jnp.minimum(cols, max_blocks - 1), axis=1)
+        bids = jnp.where(cols < max_blocks, bids, 0)  # 0 = trash block
+        offs = posm % block_size
+        # gathers hoisted above the scatter chain (see build_paged_decode):
+        # avoids a whole-pool copy-on-write per layer
+        ctx = [(kpool[li][tables].reshape(B, T_pad, KV, D),
+                vpool[li][tables].reshape(B, T_pad, KV, D))
+               for li in range(len(layer_ws))]
+        for li, w in enumerate(layer_ws):
+            x, k_new, v_new = arch["block_tail"](w, x, ctx[li][0], ctx[li][1],
+                                                 live, pos)
+            kpool = kpool.at[li, bids, offs].set(k_new)
+            vpool = vpool.at[li, bids, offs].set(v_new)
+        logits = arch["head_all"](params, x)  # (B, k+1, V)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = (logits[:, 0]
+                  / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.float32)
+        sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+        return kpool, vpool, greedy, sampled
+
+    return step
+
+
+def build_window_draft(arch, B, W, k):
+    """Model drafter: k greedy proposals per row from a SMALL same-family
+    model over a dense sliding window of the newest ``W`` tokens.
+
+    The returned pure fn ``draft(params, ids, lens)`` prefills the window
+    (``ids`` (B, W) left-aligned, ``lens`` real lengths in [1, W]) with
+    window-relative positions — an approximation for position-embedding
+    models once the stream outgrows the window, which only costs acceptance
+    rate, never correctness: the target verifies every proposal — then runs
+    k single-token greedy steps against a dense per-row cache and returns
+    the proposals (B, k) int32."""
+    KV, D = arch["kv_heads"], arch["head_dim"]
+    T_max = W + k
+
+    def draft(params, ids, lens):
+        layer_ws = params["layers"]
+        rows = jnp.arange(B)
+        x = arch["embed_prompt"](params, ids, W)
+        caches = []
+        for w in layer_ws:
+            x, (kk, vv) = arch["block"](w, x)
+            kc = jnp.zeros((B, T_max, KV, D), x.dtype).at[:, :W].set(kk)
+            vc = jnp.zeros((B, T_max, KV, D), x.dtype).at[:, :W].set(vv)
+            caches.append((kc, vc))
+        logits = arch["head_rows"](params, x, lens - 1)
+        out = jnp.zeros((B, k), jnp.int32)
+        for j in range(k):
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out = out.at[:, j].set(nxt)
+            pos = lens + j  # per-row write position of the new token
+            x = arch["embed_rows"](params, nxt, pos)
+            live = jnp.arange(T_max)[None, :] <= pos[:, None]
+            new_caches = []
+            for w, (kc, vc) in zip(layer_ws, caches):
+                x, k_new, v_new = arch["block_rows"](w, x, kc, vc, live, pos)
+                new_caches.append((kc.at[rows, pos].set(k_new),
+                                   vc.at[rows, pos].set(v_new)))
+            caches = new_caches
+            logits = arch["head"](params, x)
+        return out
+
+    return draft

@@ -126,7 +126,9 @@ class EngineConfig:
 
     def __init__(self, block_size=None, num_blocks=None, max_batch=None,
                  max_seq_len=None, prefill_batch=None, int8=None,
-                 decode_buckets=None, seed=0, max_queue=None, shed=None):
+                 decode_buckets=None, seed=0, max_queue=None, shed=None,
+                 prefix_cache=None, spec_k=None, drafter=None,
+                 draft_window=None):
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.max_batch = max_batch
@@ -137,6 +139,13 @@ class EngineConfig:
         self.seed = seed
         self.max_queue = max_queue
         self.shed = shed
+        # throughput multipliers (PR 16): prefix-cache KV sharing and
+        # speculative decoding. ``drafter`` is "ngram" or a small
+        # same-family model instance (same tokenizer/vocab as the target).
+        self.prefix_cache = prefix_cache
+        self.spec_k = spec_k
+        self.drafter = drafter
+        self.draft_window = draft_window
 
     def resolve(self, model_max_positions: int) -> "EngineConfig":
         def pick(v, name):
@@ -157,6 +166,20 @@ class EngineConfig:
                              else flags.flag("FLAGS_serve_max_queue", 0))
         if self.shed is None:
             self.shed = bool(flags.flag("FLAGS_serve_shed", False))
+        if self.prefix_cache is None:
+            self.prefix_cache = bool(flags.flag("FLAGS_serve_prefix_cache",
+                                                False))
+        self.spec_k = int(self.spec_k if self.spec_k is not None
+                          else flags.flag("FLAGS_serve_spec_k", 0))
+        if self.drafter is None:
+            self.drafter = flags.flag("FLAGS_serve_drafter", "ngram")
+        self.draft_window = int(self.draft_window
+                                if self.draft_window is not None
+                                else flags.flag("FLAGS_serve_draft_window", 64))
+        if self.spec_k < 0:
+            raise ValueError("serving: spec_k must be >= 0")
+        if self.spec_k and self.draft_window < 2:
+            raise ValueError("serving: draft_window must be >= 2")
         if self.block_size < 1 or self.num_blocks < 2 or self.max_batch < 1 \
                 or self.prefill_batch < 1 or self.max_seq_len < 1:
             raise ValueError(
@@ -232,19 +255,146 @@ def _finish(req: _Request, tokens=None, error=None, count=True) -> bool:
     return True
 
 
+def _ngram_propose(tokens, k: int, max_n: int = 3) -> List[int]:
+    """Prompt-lookup drafting (the zero-model fallback drafter): find the
+    most recent EARLIER occurrence of the longest suffix n-gram
+    (n = max_n..1) and propose the up-to-k tokens that followed it. Returns
+    [] when nothing recurs — the verify step then degenerates to plain
+    decode for that row. O(len²) worst case on pathological prompts; real
+    traffic hits in the first few candidates."""
+    L = len(tokens)
+    for n in range(min(max_n, L - 1), 0, -1):
+        pat = tokens[L - n:]
+        for i in range(L - n - 1, -1, -1):
+            if tokens[i:i + n] == pat:
+                fol = tokens[i + n:i + n + k]
+                if fol:
+                    return fol
+    return []
+
+
+class _PrefixCache:
+    """Hash-keyed index of shared prompt-prefix KV blocks (engine-thread
+    only, like the pool it feeds). Chained block-granularity hashes: block
+    j's key is ``(parent block id, tuple of chunk-j tokens)`` — the parent
+    link pins the exact content of everything before the chunk, so two
+    different prefixes can never alias through a hash collision (dict
+    hashing is a fast path, equality is exact). The index holds its OWN
+    reference on every cached block (``PagePool.share``), so retirement of
+    the inserting sequence leaves the KV resident for future admissions;
+    :meth:`evict` drops LRU LEAF entries whose block nobody else maps
+    (refcount 1) — pinned shared blocks and chain interiors are never
+    evicted from under a reader."""
+
+    __slots__ = ("_pool", "_bs", "_entries", "_by_bid", "_tick")
+
+    def __init__(self, pool: PagePool, block_size: int):
+        self._pool = pool
+        self._bs = block_size
+        # key -> [block id, last-use tick, cached-child count]
+        self._entries: Dict[tuple, list] = {}
+        self._by_bid: Dict[int, tuple] = {}  # reverse map for chain edits
+        self._tick = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def blocks(self) -> int:
+        """Pool blocks currently pinned by the index."""
+        return len(self._entries)
+
+    def match(self, tokens, limit: int) -> List[int]:
+        """Longest cached chain over full block-size chunks of ``tokens``,
+        capped at ``limit`` blocks. Returns block ids WITHOUT bumping
+        refcounts — the caller shares them once the rest of admission is
+        known to succeed."""
+        self._tick += 1
+        bids: List[int] = []
+        parent = -1
+        for j in range(limit):
+            ent = self._entries.get(
+                (parent, tuple(tokens[j * self._bs:(j + 1) * self._bs])))
+            if ent is None:
+                break
+            ent[1] = self._tick
+            bids.append(ent[0])
+            parent = ent[0]
+        return bids
+
+    def insert(self, tokens, blocks, start: int, full: int) -> int:
+        """Index ``blocks[start:full]`` of a freshly prefilled sequence
+        (chunk j's chain parent is ``blocks[j-1]``, cached and fresh blocks
+        alike). Stops at the first already-present key: that content is
+        cached under a DIFFERENT block id, and chaining ours beside it
+        would orphan the children. Takes one index-owned reference per
+        inserted block."""
+        inserted = 0
+        for j in range(start, full):
+            parent = -1 if j == 0 else blocks[j - 1]
+            key = (parent, tuple(tokens[j * self._bs:(j + 1) * self._bs]))
+            if key in self._entries:
+                break
+            self._pool.share([blocks[j]])
+            self._tick += 1
+            self._entries[key] = [blocks[j], self._tick, 0]
+            self._by_bid[blocks[j]] = key
+            pk = self._by_bid.get(parent)
+            if pk is not None:
+                self._entries[pk][2] += 1
+            inserted += 1
+        return inserted
+
+    def evict(self, need: int) -> int:
+        """Free up to ``need`` blocks by dropping LRU leaf entries whose
+        block only the index maps; dropping a leaf may expose its parent as
+        the next candidate. Returns blocks actually returned to the free
+        list (0 when everything left is pinned)."""
+        freed = 0
+        while freed < need:
+            leaves = [(ent[1], key) for key, ent in self._entries.items()
+                      if ent[2] == 0 and self._pool.refcount(ent[0]) == 1]
+            if not leaves:
+                break
+            freed += self._drop(min(leaves)[1])
+        if freed:
+            counter_inc("serve_prefix_evicted", freed)
+        return freed
+
+    def _drop(self, key) -> int:
+        bid = self._entries.pop(key)[0]
+        del self._by_bid[bid]
+        pk = self._by_bid.get(key[0])
+        if pk is not None:
+            self._entries[pk][2] -= 1
+        self._pool.free([bid])
+        return 1
+
+    def release_all(self) -> None:
+        """Drop every index-owned reference (engine shutdown)."""
+        bids = [ent[0] for ent in self._entries.values()]
+        self._entries.clear()
+        self._by_bid.clear()
+        if bids:
+            self._pool.free(bids)
+
+
 class _Seq:
     """Scheduler-side state of one admitted sequence. ``tokens`` holds
     prompt + generated ids; the newest id's KV is NOT yet in cache — its
     write position is ``pos = len(tokens) - 1``, which is also the next
-    decode step's fed token."""
+    decode step's fed token. ``cached_blocks`` counts the leading blocks
+    admission matched from the prefix cache (shared, already filled — the
+    prefill pass runs only the tail)."""
 
-    __slots__ = ("req", "tokens", "blocks", "prompt_len")
+    __slots__ = ("req", "tokens", "blocks", "prompt_len", "cached_blocks")
 
     def __init__(self, req: _Request, tokens: List[int]):
         self.req = req
         self.tokens = tokens
         self.blocks: List[int] = []
         self.prompt_len = len(req.prompt)
+        self.cached_blocks = 0
 
     @property
     def pos(self) -> int:
@@ -361,15 +511,46 @@ class Engine:
             self._dequant = None
         self._n_layers = len(params["layers"])
         kv, hd = arch["kv_heads"], arch["head_dim"]
-        self._max_blocks = -(-cfg.max_seq_len // cfg.block_size)
+        self._spec_k = int(cfg.spec_k)
+        # speculative verify writes reach pos + spec_k: widen the block
+        # tables so a real write can never clamp into the trash block
+        self._max_blocks = -(-(cfg.max_seq_len + self._spec_k)
+                             // cfg.block_size)
         shape = (self._n_layers, cfg.num_blocks, cfg.block_size, kv, hd)
         self._kpool = jnp.zeros(shape, self._dtype)
         self._vpool = jnp.zeros(shape, self._dtype)
         self._pool = PagePool(cfg.num_blocks)
         self._prefill_buckets = self._make_prefill_buckets()
+        self._prefix = (_PrefixCache(self._pool, cfg.block_size)
+                        if cfg.prefix_cache else None)
+        # drafter: None when spec is off, True for the host-side n-gram
+        # proposer, or (arch, params, window) for a small model drafter
+        self._drafter = None
+        if self._spec_k:
+            d = cfg.drafter
+            if d is None or d == "ngram":
+                self._drafter = True
+            elif isinstance(d, str):
+                raise ValueError(f"serving: unknown drafter {d!r}")
+            elif hasattr(d, "gpt"):
+                _, darch, dparams, dmax = G.gpt_decode_state(d)
+                self._drafter = (darch, dparams,
+                                 max(2, min(cfg.draft_window,
+                                            int(dmax) - self._spec_k)))
+            elif hasattr(d, "lm_head") and hasattr(d, "model"):
+                _, darch, dparams, dmax = G.llama_decode_state(d)
+                self._drafter = (darch, dparams,
+                                 max(2, min(cfg.draft_window,
+                                            int(dmax) - self._spec_k)))
+            else:
+                raise TypeError(
+                    f"serving: unsupported drafter {type(d).__name__}"
+                )
 
         # engine-thread-only scheduler state
         self._fns: Dict[tuple, object] = {}
+        # per-decode-bucket gather width (blocks), high-water, pow2-rounded
+        self._decode_mb: Dict[int, int] = {}
         self._running: List[_Seq] = []
         self._resume: List[_Seq] = []  # preempted, awaiting re-prefill
         self._admitting: List[_Seq] = []  # popped off the queue, mid-prefill
@@ -483,7 +664,9 @@ class Engine:
                 f"serving: prompt + max_new_tokens = {total} exceeds "
                 f"max_seq_len {self.config.max_seq_len}"
             )
-        if -(-total // self.config.block_size) > self._pool.num_blocks - 1:
+        # spec verify maps up to spec_k write slots past the last token
+        if -(-(total + self._spec_k) // self.config.block_size) \
+                > self._pool.num_blocks - 1:
             raise ValueError(
                 "serving: request needs more KV blocks than the whole pool; "
                 "raise FLAGS_serve_num_blocks"
@@ -540,6 +723,8 @@ class Engine:
             "pages_used": self._pool.used_blocks,
             "pages_free": self._pool.free_blocks,
             "pages_parked": self._pool.parked_blocks,
+            "pages_cached": (self._prefix.blocks
+                            if self._prefix is not None else 0),
             "compiles": len(self._fns),
             "decode_steps": self._step_i,
         }
@@ -721,7 +906,10 @@ class Engine:
                 self._prefill(self._admitting)
             self._admitting = []
             if self._running:
-                self._decode()
+                if self._spec_k:
+                    self._decode_spec()
+                else:
+                    self._decode()
             sp.set(running_after=len(self._running))
             self._oom_streak = 0
             self._maybe_unpark()
@@ -796,6 +984,10 @@ class Engine:
             if not seq.req.done.is_set():
                 self._resume.append(seq)
         self._admitting = []
+        if self._prefix is not None and len(self._prefix):
+            # cached-prefix KV is the most expendable resident state under
+            # exhaustion — drop half before parking shrinks live headroom
+            self._prefix.evict(max(len(self._prefix) // 2, 1))
         parked = self._pool.park(max(self._pool.free_blocks // 4, 1))
         if parked:
             counter_inc("serve_pool_shrunk", parked)
@@ -896,6 +1088,37 @@ class Engine:
         # just packed in (a prefill paid, then discarded, is pure waste)
         return self._pool.free_blocks - need >= len(self._running) + extra_running
 
+    def _alloc_with_reclaim(self, need: int, extra_running: int):
+        """Block grant with prefix-cache reclaim: unpinned cached blocks are
+        free headroom in disguise, so LRU cache entries are evicted before
+        admission declares backpressure or a grower preempts a peer."""
+        if self._headroom_ok(need, extra_running):
+            got = self._pool.alloc(need)
+            if got is not None:
+                return got
+        if self._prefix is not None and len(self._prefix):
+            want = (need + len(self._running) + extra_running
+                    - self._pool.free_blocks)
+            if self._prefix.evict(max(want, 1)) \
+                    and self._headroom_ok(need, extra_running):
+                return self._pool.alloc(need)
+        return None
+
+    def _match_prefix(self, tokens) -> List[int]:
+        """Longest-prefix cache lookup for an admission candidate; matched
+        blocks are shared (refcount-bumped) here — callers must ``free``
+        them on any later admission failure. Capped one token short of the
+        whole sequence: prefill must always produce first-token logits."""
+        limit = (len(tokens) - 1) // self.config.block_size
+        bids = self._prefix.match(tokens, limit)
+        if bids:
+            self._pool.share(bids)
+            counter_inc("serve_prefix_hits")
+            counter_inc("serve_prefix_blocks_shared", len(bids))
+        else:
+            counter_inc("serve_prefix_misses")
+        return bids
+
     def _admit(self) -> List[_Seq]:
         admitted: List[_Seq] = []
         with span("admit") as sp:
@@ -903,16 +1126,21 @@ class Engine:
             # latency clock is running
             still_resume = []
             for seq in self._resume:
-                need = -(-len(seq.tokens) // self.config.block_size)
                 if len(self._running) + len(admitted) >= self.config.max_batch:
                     still_resume.append(seq)
                     continue
-                blocks = (self._pool.alloc(need)
-                          if self._headroom_ok(need, len(admitted) + 1) else None)
+                matched = (self._match_prefix(seq.tokens)
+                           if self._prefix is not None else [])
+                need = (-(-len(seq.tokens) // self.config.block_size)
+                        - len(matched))
+                blocks = self._alloc_with_reclaim(need, len(admitted) + 1)
                 if blocks is None:
+                    if matched:
+                        self._pool.free(matched)
                     still_resume.append(seq)
                     continue
-                seq.blocks = blocks
+                seq.blocks = matched + blocks
+                seq.cached_blocks = len(matched)
                 admitted.append(seq)
             self._resume = still_resume
             # ONE ordered snapshot per admission pass, not an O(queue) scan
@@ -941,19 +1169,24 @@ class Engine:
                         self._finish_request(req, error=RequestCancelled(
                             f"request {req.id} cancelled"))
                         continue
-                    need = -(-len(req.prompt) // self.config.block_size)
-                    blocks = (self._pool.alloc(need)
-                              if self._headroom_ok(need, len(admitted) + 1) else None)
+                    matched = (self._match_prefix(req.prompt)
+                               if self._prefix is not None else [])
+                    need = (-(-len(req.prompt) // self.config.block_size)
+                            - len(matched))
+                    blocks = self._alloc_with_reclaim(need, len(admitted) + 1)
                     if blocks is None:
+                        if matched:
+                            self._pool.free(matched)
                         counter_inc("serve_backpressure")
                         break
                     try:
                         self._waiting.remove(req)
                     except ValueError:  # raced away mid-pass — undo the grant
-                        self._pool.free(blocks)
+                        self._pool.free(matched + blocks)
                         continue
                 seq = _Seq(req, list(req.prompt))
-                seq.blocks = blocks
+                seq.blocks = matched + blocks
+                seq.cached_blocks = len(matched)
                 admitted.append(seq)
             if admitted:
                 counter_inc("serve_admitted", len(admitted))
@@ -963,12 +1196,21 @@ class Engine:
     # -- prefill -------------------------------------------------------------
     def _prefill(self, seqs: List[_Seq]):
         jnp = self._jnp
+        bw = self.config.prefill_batch
+        bs = self.config.block_size
+        # rows that matched the prefix cache run the TAIL program (bucketed
+        # by tail length, reading the shared prefix from the pool); misses
+        # run the PR 11 full-prompt program unchanged
         groups: Dict[int, List[_Seq]] = {}
+        tail_groups: Dict[int, List[_Seq]] = {}
         for s in seqs:
-            groups.setdefault(self._bucket_for(len(s.tokens)), []).append(s)
+            if s.cached_blocks:
+                tail = len(s.tokens) - s.cached_blocks * bs
+                tail_groups.setdefault(self._bucket_for(tail), []).append(s)
+            else:
+                groups.setdefault(self._bucket_for(len(s.tokens)), []).append(s)
         for t_bucket in sorted(groups):
             group = groups[t_bucket]
-            bw = self.config.prefill_batch
             for i in range(0, len(group), bw):
                 chunk = group[i:i + bw]
                 with span("prefill", bucket_t=t_bucket, bucket_b=bw,
@@ -1000,10 +1242,54 @@ class Engine:
                     # and declare a spurious wedge after a long compile
                     self._beat = time.monotonic()
                     self._compiling = False
+                    self._land_prefill(chunk, rows)
+        for t_bucket in sorted(tail_groups):
+            group = tail_groups[t_bucket]
+            for i in range(0, len(group), bw):
+                chunk = group[i:i + bw]
+                with span("prefill", bucket_t=t_bucket, bucket_b=bw,
+                          rows=len(chunk), shared=True):
+                    self._beat = time.monotonic()
+                    n_fns = len(self._fns)
+                    fn = self._get_fn("prefill_tail", bw, t_bucket)
+                    self._compiling = len(self._fns) != n_fns
+                    ids = np.zeros((bw, t_bucket), np.int32)
+                    starts = np.zeros((bw,), np.int32)
+                    lens = np.ones((bw,), np.int32)
+                    tables = np.full((bw, self._max_blocks), TRASH_BLOCK,
+                                     np.int32)
                     for r, s in enumerate(chunk):
-                        self._append_token(s, self._sample_host(rows[r], s.req))
-                        if not s.req.done.is_set():
-                            self._running.append(s)
+                        start = s.cached_blocks * bs
+                        ids[r, :len(s.tokens) - start] = s.tokens[start:]
+                        starts[r] = start
+                        lens[r] = len(s.tokens) - start
+                        tables[r, :len(s.blocks)] = s.blocks
+                    self._kpool, self._vpool, logits = fn(
+                        self._compute_params, jnp.asarray(ids),
+                        jnp.asarray(starts), jnp.asarray(lens),
+                        jnp.asarray(tables), self._kpool, self._vpool,
+                    )
+                    counter_inc("serve_prefills")
+                    counter_inc("serve_tail_prefills")
+                    rows = np.asarray(logits)
+                    self._beat = time.monotonic()
+                    self._compiling = False
+                    self._land_prefill(chunk, rows)
+
+    def _land_prefill(self, chunk: List[_Seq], rows: np.ndarray):
+        """Post-prefill landing: index cacheable prompt blocks (while the
+        sequence still owns them — the index takes its own reference, so a
+        first-token retirement keeps the KV resident), then sample the
+        first generated token and move the sequence into the running set."""
+        for r, s in enumerate(chunk):
+            if self._prefix is not None:
+                full = s.prompt_len // self.config.block_size
+                if full > s.cached_blocks:
+                    self._prefix.insert(s.tokens, s.blocks,
+                                        s.cached_blocks, full)
+            self._append_token(s, self._sample_host(rows[r], s.req))
+            if not s.req.done.is_set():
+                self._running.append(s)
 
     def _sample_host(self, logits_row: np.ndarray, req: _Request) -> int:
         """First generated token (prefill output) is sampled host-side; the
@@ -1027,10 +1313,17 @@ class Engine:
         for seq in list(self._running):
             if seq not in self._running:
                 continue  # evicted by an earlier iteration
-            need = seq.pos // self.config.block_size + 1 - len(seq.blocks)
+            # spec verify writes k slots past pos — map those blocks too
+            need = ((seq.pos + self._spec_k) // self.config.block_size + 1
+                    - len(seq.blocks))
             while need > 0:
                 with span("page_alloc", request=seq.req.id, blocks=need):
                     got = self._pool.alloc(need)
+                    if got is None and self._prefix is not None \
+                            and len(self._prefix):
+                        # reclaim unpinned cache before preempting a peer
+                        self._prefix.evict(need - self._pool.free_blocks)
+                        got = self._pool.alloc(need)
                 if got is not None:
                     seq.blocks.extend(got)
                     break
@@ -1057,14 +1350,68 @@ class Engine:
             self._resume.append(seq)
             counter_inc("serve_preempted")
 
+    def _gather_width(self, bb: int) -> int:
+        """Per-decode-bucket gather width (ROADMAP item 1 leftover): the
+        compiled step gathers this many blocks per row instead of the
+        engine-wide ``_max_blocks`` — sized to the bucket's HIGH-WATER live
+        block count, rounded up to a power of two (recompiles bounded at
+        log2 per bucket), never shrinking. A width upgrade REPLACES the
+        bucket's compiled entry, so ``stats()['compiles']`` stays bounded
+        by the bucket count. Bit-identity is free: the dropped columns were
+        all trash-block padding behind every row's live mask."""
+        hw = max(len(s.blocks) for s in self._running)
+        mb = self._decode_mb.get(bb, 0)
+        if hw > mb:
+            mb = 1
+            while mb < hw:
+                mb *= 2
+            mb = min(mb, self._max_blocks)
+            old = self._decode_mb.get(bb)
+            if old is not None:
+                self._fns.pop(("decode", bb, old), None)
+                self._fns.pop(("spec", bb, old), None)
+            self._decode_mb[bb] = mb
+        return mb
+
+    def _cow_guard(self, seq: _Seq):
+        """Copy-on-write: a write-range block still shared with the prefix
+        index or a peer is copied into a private block before the step
+        writes it. The admission policy keeps shared prefix blocks strictly
+        BELOW every write column (matching is capped at full prompt blocks,
+        writes start at ``prompt_len``), so this is defense in depth — it
+        keeps peers bit-intact even if a future scheduler maps shared
+        blocks more aggressively."""
+        bs = self.config.block_size
+        lo, hi = seq.pos // bs, (seq.pos + self._spec_k) // bs
+        for col in range(lo, min(hi + 1, len(seq.blocks))):
+            bid = seq.blocks[col]
+            if self._pool.refcount(bid) <= 1:
+                continue
+            repl = self._alloc_with_reclaim(1, 0)
+            if repl is None:
+                raise ServeError(
+                    f"page pool exhausted during copy-on-write "
+                    f"(request {seq.req.id})"
+                )
+            new = repl[0]
+            self._kpool = self._kpool.at[:, new].set(self._kpool[:, bid])
+            self._vpool = self._vpool.at[:, new].set(self._vpool[:, bid])
+            seq.blocks[col] = new
+            self._pool.free([bid])
+            counter_inc("serve_cow_copies")
+
     def _decode(self):
         jnp, jax = self._jnp, self._jax
         self._grow_blocks()
         if not self._running:
             return
+        if self._prefix is not None:
+            for s in self._running:
+                self._cow_guard(s)
         n = len(self._running)
         bb = next(b for b in self.config.decode_buckets if b >= n)
-        tables = np.full((bb, self._max_blocks), TRASH_BLOCK, np.int32)
+        mb = self._gather_width(bb)
+        tables = np.full((bb, mb), TRASH_BLOCK, np.int32)
         pos = np.zeros((bb,), np.int32)
         toks = np.zeros((bb,), np.int32)
         temps = np.zeros((bb,), np.float32)
@@ -1074,11 +1421,13 @@ class Engine:
             toks[r] = s.tokens[-1]
             temps[r] = s.req.temperature
         self._key, sub = jax.random.split(self._key)
-        n_fns = len(self._fns)
+        # a width upgrade pops the old entry, so compare by key presence,
+        # not _fns length
+        warm = ("decode", bb, mb) in self._fns
         with span("decode_step", bucket=bb, rows=n, step=self._step_i):
             self._beat = time.monotonic()  # staleness clock covers this op
-            fn = self._get_fn("decode", bb)
-            self._compiling = len(self._fns) != n_fns
+            fn = self._get_fn("decode", bb, mb)
+            self._compiling = not warm
             t0 = time.monotonic()
             self._kpool, self._vpool, nxt = fn(
                 self._compute_params, self._kpool, self._vpool,
@@ -1089,9 +1438,9 @@ class Engine:
         self._beat = time.monotonic()  # beat before dropping compile grace
         self._compiling = False
         # decode service-time EMA feeds deadline feasibility + Retry-After
-        # hints; compile steps (a new _fns entry this step) are excluded —
-        # they would make every early deadline look doomed
-        if len(self._fns) == n_fns:
+        # hints; compile steps are excluded — they would make every early
+        # deadline look doomed
+        if warm:
             dt = time.monotonic() - t0
             self._ema_step_s = (dt if not self._ema_step_s
                                 else 0.8 * self._ema_step_s + 0.2 * dt)
@@ -1103,6 +1452,117 @@ class Engine:
         counter_inc("serve_occupancy_slots", bb)
         for r, s in enumerate(list(self._running)):
             self._append_token(s, int(nxt[r]))
+
+    # -- speculative decode ---------------------------------------------------
+    def _propose(self, bb: int) -> np.ndarray:
+        """Per-row draft proposals (bb, spec_k) for the greedy rows, -1
+        padded (a -1 can never equal a verify argmax, so unproposed slots
+        accept nothing and the step degenerates to plain decode)."""
+        k = self._spec_k
+        drafts = np.full((bb, k), -1, np.int32)
+        greedy_rows = [(r, s) for r, s in enumerate(self._running)
+                       if s.req.temperature <= 0.0]
+        if not greedy_rows:
+            return drafts
+        if self._drafter is True:  # host-side n-gram prompt lookup
+            for r, s in greedy_rows:
+                got = _ngram_propose(s.tokens, k)
+                drafts[r, :len(got)] = got
+            return drafts
+        darch, dparams, W = self._drafter
+        ids = np.zeros((bb, W), np.int32)
+        lens = np.ones((bb,), np.int32)
+        for r, s in greedy_rows:
+            tl = min(len(s.tokens), W)
+            ids[r, :tl] = s.tokens[-tl:]
+            lens[r] = tl
+        warm = ("draft", bb) in self._fns
+        with span("draft", bucket=bb, rows=len(greedy_rows)):
+            self._beat = time.monotonic()
+            fn = self._get_fn("draft", bb)
+            self._compiling = not warm
+            out = np.asarray(fn(dparams, self._jnp.asarray(ids),
+                                self._jnp.asarray(lens)))
+            self._beat = time.monotonic()
+            self._compiling = False
+        for r, _ in greedy_rows:
+            drafts[r] = out[r]
+        return drafts
+
+    def _decode_spec(self):
+        """One speculative scheduler step: draft k tokens per row, verify
+        all of them (plus the pending next-input token) in ONE compiled
+        paged step, accept the longest agreeing prefix. Greedy rows emit
+        1..k+1 tokens per step bit-identically to plain decode; sampling
+        rows take the j=0 sampled token and accept no drafts."""
+        jnp, jax = self._jnp, self._jax
+        k = self._spec_k
+        self._grow_blocks()
+        if not self._running:
+            return
+        if self._prefix is not None:
+            for s in self._running:
+                self._cow_guard(s)
+        n = len(self._running)
+        bb = next(b for b in self.config.decode_buckets if b >= n)
+        mb = self._gather_width(bb)
+        drafts = self._propose(bb)
+        tables = np.full((bb, mb), TRASH_BLOCK, np.int32)
+        pos = np.zeros((bb,), np.int32)
+        toks = np.zeros((bb, k + 1), np.int32)
+        temps = np.zeros((bb,), np.float32)
+        for r, s in enumerate(self._running):
+            tables[r, :len(s.blocks)] = s.blocks
+            pos[r] = s.pos
+            toks[r, 0] = s.tokens[-1]
+            toks[r, 1:] = drafts[r]
+            temps[r] = s.req.temperature
+        self._key, sub = jax.random.split(self._key)
+        warm = ("spec", bb, mb) in self._fns
+        with span("decode_step", bucket=bb, rows=n, step=self._step_i,
+                  spec_k=k) as sp:
+            self._beat = time.monotonic()
+            fn = self._get_fn("spec", bb, mb)
+            self._compiling = not warm
+            t0 = time.monotonic()
+            self._kpool, self._vpool, greedy, sampled = fn(
+                self._compute_params, self._kpool, self._vpool,
+                jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(toks),
+                jnp.asarray(temps), sub,
+            )
+            greedy, sampled = np.asarray(greedy), np.asarray(sampled)
+            proposed = accepted = 0
+            for r, s in enumerate(list(self._running)):
+                if temps[r] > 0.0:
+                    self._append_token(s, int(sampled[r]))
+                    continue
+                nprop = int(np.sum(drafts[r] >= 0))
+                m = 0
+                while m < nprop and drafts[r, m] == greedy[r, m]:
+                    m += 1
+                proposed += nprop
+                accepted += m
+                # the m accepted drafts re-emerge as the target's own argmax
+                # continuations, plus the bonus token after the last one
+                for j in range(m + 1):
+                    if s.req.done.is_set():
+                        break
+                    self._append_token(s, int(greedy[r, j]))
+            sp.set(drafted=proposed, accepted=accepted)
+        self._beat = time.monotonic()
+        self._compiling = False
+        if warm:
+            dt = time.monotonic() - t0
+            self._ema_step_s = (dt if not self._ema_step_s
+                                else 0.8 * self._ema_step_s + 0.2 * dt)
+        self._step_i += 1
+        self._occ_live += n
+        self._occ_slots += bb
+        counter_inc("serve_decode_steps")
+        counter_inc("serve_occupancy_live", n)
+        counter_inc("serve_occupancy_slots", bb)
+        counter_inc("serve_draft_proposed", proposed)
+        counter_inc("serve_draft_accepted", accepted)
 
     def _append_token(self, seq: _Seq, tok: int):
         """Record one generated token; retire the sequence when it hits eos,
@@ -1160,6 +1620,11 @@ class Engine:
 
     def _shutdown(self):
         err = self._broken or ServeError("serving engine closed")
+        if self._prefix is not None:
+            try:
+                self._prefix.release_all()
+            except Exception:  # lint: ok(oom-handler) — corrupt-pool containment sweep, crash already classified in _step
+                pass
         with self._cv:
             waiting = list(self._waiting)
             self._waiting.clear()
@@ -1197,10 +1662,30 @@ class Engine:
                     self._arch, bw, t_bucket, self.config.block_size,
                     self._max_blocks)
                 donate = (4, 5)
-            else:
+            elif kind == "prefill_tail":
+                bw, t_bucket = bucket
+                raw = G.build_paged_tail_prefill(
+                    self._arch, bw, t_bucket, self.config.block_size,
+                    self._max_blocks)
+                donate = (5, 6)
+            elif kind == "spec":
+                bb, mb = bucket
+                raw = G.build_paged_spec_decode(
+                    self._arch, bb, self._spec_k, self.config.block_size, mb)
+                donate = (1, 2)
+            elif kind == "draft":
+                # drafter weights, not the (possibly int8) target params —
+                # no dequant wrapper, nothing donated
                 (bb,) = bucket
+                darch, _, W = self._drafter
+                fn = jax.jit(G.build_window_draft(darch, bb, W, self._spec_k))
+                self._fns[key] = fn
+                counter_inc("serve_compiles")
+                return fn
+            else:
+                bb, mb = bucket
                 raw = G.build_paged_decode(
-                    self._arch, bb, self.config.block_size, self._max_blocks)
+                    self._arch, bb, self.config.block_size, mb)
                 donate = (1, 2)
             if self._dequant is not None:
                 dq, inner = self._dequant, raw
@@ -1225,6 +1710,9 @@ class Engine:
         return {
             "queue_depth": depth,
             "step": self._step_i,
+            "spec_k": self._spec_k,
+            "prefix_cached_blocks": (self._prefix.blocks
+                                     if self._prefix is not None else 0),
             "pages": {"used": self._pool.used_blocks,
                       "free": self._pool.free_blocks,
                       "parked": self._pool.parked_blocks},

@@ -23,10 +23,19 @@ cannot see, shrinking admission headroom so continuous batching backs off
 to a smaller resident working set — backpressure, never a crash. ``check``
 counts parked blocks in the conservation invariant; :meth:`unpark` gives
 them back once pressure clears.
+
+Blocks are REFCOUNTED (prefix-cache KV sharing): ``alloc`` hands out blocks
+at refcount 1, :meth:`share` bumps an owned block so several sequences (or
+the engine's prefix index) can map the same physical block, and ``free``
+decrements — the block returns to the free list only when the last
+reference drops. Freeing an unowned id still raises (double-free), and
+``park`` only ever draws from the free list, so a block with live
+references can structurally never be parked — PR 14's OOM pool-shrink is
+safe under sharing by construction.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..profiler import counter_inc
 
@@ -43,6 +52,7 @@ class PagePool:
         # LIFO free list: recently-freed blocks are re-used first (warm)
         self._free: List[int] = list(range(self.num_blocks - 1, TRASH_BLOCK, -1))
         self._owned = set()
+        self._ref: Dict[int, int] = {}  # owned block id -> reference count
         # blocks withdrawn from circulation under memory pressure (park()):
         # invisible to alloc, still conserved by check()
         self._parked: List[int] = []
@@ -64,18 +74,44 @@ class PagePool:
             return None
         ids = [self._free.pop() for _ in range(n)]
         self._owned.update(ids)
+        for b in ids:
+            self._ref[b] = 1
         counter_inc("serve_pages_allocated", n)
         return ids
 
+    def share(self, ids) -> None:
+        """Bump the refcount of already-owned blocks (prefix-cache sharing):
+        each sharer later calls ``free`` once, and the block only returns to
+        circulation when the last reference drops. Sharing an unowned id
+        raises — a sharer can only piggyback on a live block."""
+        for b in ids:
+            if b not in self._owned:
+                raise RuntimeError(f"PagePool: share of unowned block id {b}")
+        for b in ids:
+            self._ref[b] += 1
+        if ids:
+            counter_inc("serve_pages_shared", len(ids))
+
+    def refcount(self, bid: int) -> int:
+        """Current reference count of a block (0 = not owned)."""
+        return self._ref.get(bid, 0)
+
     def free(self, ids) -> None:
+        """Drop one reference per id; a block returns to the free list when
+        its count hits zero. Freeing an unowned id raises (double-free)."""
+        released = 0
         for b in ids:
             if b not in self._owned:
                 raise RuntimeError(
                     f"PagePool: double-free or foreign block id {b}"
                 )
-            self._owned.remove(b)
-            self._free.append(b)
-        counter_inc("serve_pages_freed", len(ids))
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                self._owned.remove(b)
+                self._free.append(b)
+                released += 1
+        counter_inc("serve_pages_freed", released)
 
     @property
     def parked_blocks(self) -> int:
@@ -115,13 +151,15 @@ class PagePool:
         if self._owned:
             lost = next(iter(self._owned))
             self._owned.discard(lost)
+            self._ref.pop(lost, None)
         elif self._free:
             self._free.append(self._free[-1])
         counter_inc("serve_pool_damaged")
 
     def check(self) -> None:
         """Conservation invariant: every non-trash block is exactly one of
-        free, owned, or parked."""
+        free, owned, or parked; every owned block carries a refcount >= 1
+        and nothing else does (refcounts never leak past ownership)."""
         if len(self._free) + len(self._owned) + len(self._parked) \
                 != self.num_blocks - 1:
             raise RuntimeError(
@@ -135,3 +173,9 @@ class PagePool:
             raise RuntimeError("PagePool: block in two states at once")
         if TRASH_BLOCK in self._owned or TRASH_BLOCK in circulating:
             raise RuntimeError("PagePool: trash block entered circulation")
+        if set(self._ref) != self._owned:
+            raise RuntimeError(
+                "PagePool: refcount bookkeeping diverged from ownership"
+            )
+        if any(c < 1 for c in self._ref.values()):
+            raise RuntimeError("PagePool: owned block with refcount < 1")

@@ -133,6 +133,63 @@ class TestStraggler:
             assert eng.stats()["pages_used"] == 0
 
 
+class TestSharingUnderChaos:
+    def test_crash_mid_share_restart_bit_identical_with_cache_armed(self, model):
+        """serve.crash fires while streams are actively sharing cached
+        prefix blocks: the dying engine's containment sweep must release
+        the index's references without double-freeing the sharers' (one
+        pool, many refs per block), and the supervisor's restart — fresh
+        pool, fresh cache — re-prefills and stays bit-identical."""
+        rng = np.random.RandomState(10)
+        shared = rng.randint(0, 211, (40,)).tolist()
+        prompts = [shared + rng.randint(0, 211, (int(rng.randint(3, 10)),)).tolist()
+                   for _ in range(12)]
+        with Engine(model, **_KW) as eng:
+            baseline = [eng.submit(p, max_new_tokens=10).result(timeout=600)
+                        for p in prompts]
+        inject.arm({"serve.crash": {"at": 5}})
+        with ServingSupervisor(model, watchdog_s=5.0, prefix_cache=True,
+                               **_KW) as sup:
+            hs = [sup.submit(p, max_new_tokens=10) for p in prompts]
+            deadline = time.monotonic() + 60
+            while not inject.fired_counts().get("serve.crash") \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            inject.arm({"serve.crash": {"at": 7}})
+            outs = [h.result(timeout=600) for h in hs]
+            assert sup.restarts == 2
+            # restarted engine's pool conserves with the cache re-armed:
+            # the only residents are the index's own references
+            st = sup.stats()
+            assert st["pages_used"] == st["pages_cached"]
+        assert outs == baseline
+
+    def test_preemption_of_sharers_over_rounds_never_corrupts_peers(self, model):
+        """Multi-round sharer-preemption drive: a pool sized so concurrent
+        growth past the shared prefix must preempt sharers repeatedly.
+        Victims re-match the cache on resume, peers keep decoding off the
+        same physical blocks, and every round is bit-identical with the
+        pool conserving (no double-free of a shared block, ever)."""
+        rng = np.random.RandomState(11)
+        shared = rng.randint(0, 211, (40,)).tolist()
+        prompts = [shared + rng.randint(0, 211, (6,)).tolist()
+                   for _ in range(4)]
+        with Engine(model, **_KW) as eng:
+            baseline = [eng.submit(p, max_new_tokens=24).result(timeout=600)
+                        for p in prompts]
+        kw = dict(ENGINE_KW, num_blocks=20)
+        preempted = profiler.counters().get("serve_preempted", 0)
+        with Engine(model, prefix_cache=True, **kw) as eng:
+            for _ in range(4):
+                hs = [eng.submit(p, max_new_tokens=24) for p in prompts]
+                outs = [h.result(timeout=600) for h in hs]
+                assert outs == baseline
+                eng._pool.check()
+            st = eng.stats()
+            assert st["pages_used"] == st["pages_cached"]
+        assert profiler.counters().get("serve_preempted", 0) > preempted
+
+
 class TestOverloadStorm:
     def test_shed_keeps_engine_healthy_and_latency_bounded(self, model):
         """A 4x-style open-loop storm against a shed-armed engine: some
