@@ -1330,12 +1330,15 @@ def bench_serving(paddle, jax, np, on_tpu):
     at 4x the measured sustainable load with deadlines + fast-fail shedding
     armed (round 12 resilience layer) — the engine must shed instead of
     stalling, keeping admitted-request p99 bounded. Ends with the
-    high-prefix-overlap A/B (`_bench_serving_prefix_spec`). Prints ONE
-    `SERVE_PERF` JSON line (p50/p99 request latency, generated tokens/sec,
-    mean decode batch occupancy, compile count, the overload window's
-    shed-rate / deadline-miss-rate / p99-under-overload, and the prefix/
-    speculative hit- and acceptance-rates with speedup-vs-baseline) and
-    returns the same dict for extra_metrics."""
+    high-prefix-overlap A/B (`_bench_serving_prefix_spec`) and the
+    crash-recovery A/B (`_bench_serving_recovery`: re-prefill vs snapshot
+    re-attach MTTR). Prints ONE `SERVE_PERF` JSON line (p50/p99 request
+    latency, generated tokens/sec, mean decode batch occupancy, compile
+    count, the overload window's shed-rate / deadline-miss-rate /
+    p99-under-overload, the prefix/speculative hit- and acceptance-rates
+    with speedup-vs-baseline, and the recovery round's per-arm MTTR +
+    re-prefilled-tokens vs re-attached-blocks) and returns the same dict
+    for extra_metrics."""
     import threading
 
     from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
@@ -1418,8 +1421,64 @@ def bench_serving(paddle, jax, np, on_tpu):
         np, model, ekw, prompts, max_new, streams / wall, p99_unloaded)
     line["prefix_spec"] = _bench_serving_prefix_spec(
         np, model, cfg.vocab_size, ekw, on_tpu)
+    line["recovery"] = _bench_serving_recovery(np, model, ekw, prompts,
+                                               max_new)
     print("SERVE_PERF " + json.dumps(line))
     return line
+
+
+def _bench_serving_recovery(np, model, ekw, prompts, max_new):
+    """Crash-recovery A/B (ISSUE-17): the same injected mid-decode crash
+    recovered two ways — the PR 12 re-prefill/requeue path vs snapshot
+    re-attach (``snapshot=True``). Reports, per arm, the supervisor's
+    detect→recover MTTR, the crash→fully-drained wall (the serving-level
+    MTTR: when the service has actually caught up), and how many tokens
+    were re-prefilled vs how many KV blocks re-attached. The acceptance
+    bar: re-attach re-prefills ZERO tokens and drains faster than
+    re-prefill (``mttr_speedup_x`` > 1)."""
+    from paddle_tpu import profiler as _prof
+    from paddle_tpu.fault import inject
+    from paddle_tpu.serving import ServingSupervisor
+
+    n = min(16, len(prompts))
+    ps = prompts[:n]
+    out = {"streams": n, "max_new": max_new}
+    try:
+        for name, snap in (("reprefill", False), ("reattach", True)):
+            c0 = _prof.counters()
+            inject.arm("serve.crash:at=6")
+            with ServingSupervisor(model, watchdog_s=5.0, snapshot=snap,
+                                   **ekw) as sup:
+                hs = [sup.submit(p, max_new_tokens=max_new) for p in ps]
+                deadline = time.monotonic() + 120
+                while not inject.fired_counts().get("serve.crash") \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.002)
+                t0 = time.monotonic()
+                [h.result(timeout=600) for h in hs]
+                drain = time.monotonic() - t0
+                assert sup.restarts == 1
+                mode = sup.health()["last_recovery"]["mode"]
+            inject.disarm()
+            c1 = _prof.counters()
+
+            def d(k):
+                return c1.get(k, 0) - c0.get(k, 0)
+
+            out[name] = {
+                "mode": mode,
+                "supervisor_mttr_ms": d("serve_restart_mttr_ms"),
+                "crash_to_drained_s": round(drain, 3),
+                "reprefill_tokens": d("serve_reprefill_tokens"),
+                "reattached_blocks": d("serve_reattached_blocks"),
+                "reprefill_tokens_saved": d("serve_reprefill_tokens_saved"),
+            }
+    finally:
+        inject.disarm()
+    out["mttr_speedup_x"] = round(
+        out["reprefill"]["crash_to_drained_s"]
+        / max(out["reattach"]["crash_to_drained_s"], 1e-9), 3)
+    return out
 
 
 def _bench_serving_prefix_spec(np, model, vocab, ekw, on_tpu):

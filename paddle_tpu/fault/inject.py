@@ -46,6 +46,10 @@ consulted by the serving engine's scheduler thread at every step boundary —
 they drive the ServingSupervisor recovery suite (tests/test_serving_chaos.py).
 ``serve.wedge`` wedges the scheduler thread forever by default (the
 supervisor abandons it); ``ms=N`` bounds the wedge for detection-only tests.
+``serve.snapshot_corrupt`` fires inside ``Engine.snapshot`` (crash re-attach
+and handoff captures alike) and tears the exported pool bookkeeping —
+``Engine.adopt`` must reject the capture with ``SnapshotError`` and fall
+back whole to re-prefill recovery (tests/test_serving_snapshot.py).
 Training-stability chaos points (``loss.spike`` / ``grad.spike``) are
 consulted at the step boundary via :func:`spike` — they scale the step's
 loss/gradients by ``scale=`` (or poison them non-finite with
@@ -89,6 +93,8 @@ POINTS: Dict[str, str] = {
     "serve.wedge": "serving engine loop — wedge the scheduler thread (ms=N bounds it)",
     "serve.slow_step": "serving engine loop — per-step straggler delay (ms=N, default 100)",
     "serve.pool_corrupt": "serving engine loop — break PagePool conservation (next free raises)",
+    "serve.snapshot_corrupt": ("Engine.snapshot — tear the pool capture so "
+                               "adopt() must reject it and fall back whole"),
     # -- HBM memory-pressure chaos points (fault/memory.py consumers) ---------
     "hbm.oom": ("named dispatch sites (op=lazy_flush/engine.step/engine.accum/"
                 "serve.step) — synthesize an XLA RESOURCE_EXHAUSTED there"),

@@ -130,6 +130,18 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_serve_max_queue": 0,
     "FLAGS_serve_shed": False,
     "FLAGS_serve_watchdog_s": 10.0,
+    # Serving state durability (PR 17). With FLAGS_serve_snapshot on, the
+    # ServingSupervisor's crash recovery captures the dead engine's frozen
+    # serving state (PagePool bookkeeping + KV pool arrays + block tables +
+    # prefix-cache chain, validated end-to-end) and the replacement engine
+    # RE-ATTACHES the surviving blocks — streams resume mid-decode with
+    # zero re-prefilled tokens, bit-identical to an uninterrupted run. A
+    # capture that fails validation falls back to the PR 12 re-prefill
+    # path, so recovery is never worse than before. Off (default): the
+    # snapshot/adopt code paths are never reached (inert tripwire in
+    # tests/test_serving_snapshot.py); Engine.handoff() is an explicit API
+    # and needs no flag. Supervisor snapshot= overrides per instance.
+    "FLAGS_serve_snapshot": False,
     # Training stability sentinel (fault/sentinel.py): statistical anomaly
     # detection over per-step signals (loss, global grad norm, update/param
     # ratio, non-finite rate) with a skip -> rollback -> halt policy ladder,

@@ -747,6 +747,31 @@ def build_paged_decode(arch, B, block_size, max_blocks):
     return step
 
 
+def kv_block_checksums(kpool, vpool, bids):
+    """Per-block content fingerprints of paged KV state — the resume-at-
+    position validation entry for serving snapshots.
+
+    A re-attached sequence resumes mid-decode through ``build_paged_decode``
+    with its restored block table and ``pos`` — the compiled step needs no
+    special resume path, but the KV bytes it reads must be the ones the dead
+    engine wrote. This computes, for each block id in ``bids``, a
+    deterministic ``(Σ|K|, Σ|V|)`` float64 reduction over that block's rows
+    across all layers. ``Engine.snapshot()`` records the fingerprints of
+    every owned block and ``Engine.adopt()`` recomputes them over the
+    handed-over arrays: a mismatch (tampered/zeroed pool rows, dtype drift)
+    is a structured ``SnapshotError`` — never a wrong-KV serve. Same arrays
+    + same backend → bit-identical sums, so a clean handoff always matches.
+
+    Returns an ``np.ndarray`` of shape ``(len(bids), 2)``; O(blocks) device
+    work on the recovery path only."""
+    if not len(bids):
+        return np.zeros((0, 2), dtype=np.float64)
+    idx = jnp.asarray(np.asarray(bids, dtype=np.int32))
+    k = jnp.abs(kpool[:, idx].astype(jnp.float32)).sum(axis=(0, 2, 3, 4))
+    v = jnp.abs(vpool[:, idx].astype(jnp.float32)).sum(axis=(0, 2, 3, 4))
+    return np.stack([np.asarray(k), np.asarray(v)], axis=1).astype(np.float64)
+
+
 def build_paged_tail_prefill(arch, B, T_bucket, block_size, max_blocks):
     """Prefix-cache tail prefill: prompt heads already live in shared pool
     blocks, only the TAIL tokens run the forward pass.

@@ -21,6 +21,16 @@ engine loop within ``FLAGS_serve_watchdog_s`` and restarts it with greedy
 in-flight work requeued bit-identically; ``health()``/``ready()`` +
 ``close(drain=True)`` support rolling restarts.
 
+Serving state durability (round 17): live serving state is a first-class
+durable object — ``PagePool.snapshot()/restore()`` capture/rebuild the
+allocator with full validation, ``Engine.snapshot()/adopt()`` carry the
+whole engine (KV arrays, block tables, prefix chain) across a restart so a
+supervised crash with ``FLAGS_serve_snapshot`` RE-ATTACHES survivors with
+zero re-prefilled tokens, and ``Engine.handoff()`` quiesces + exports
+everything for a successor engine (zero-downtime upgrade). A capture that
+fails validation is a structured ``SnapshotError`` and recovery falls back
+to re-prefill — never a wrong-KV serve.
+
 See serving/engine.py for the scheduler, serving/pool.py for the paged KV
 block allocator, serving/int8.py for the weight-only int8 path,
 serving/supervisor.py for crash/wedge recovery, and the README "Serving"
@@ -31,11 +41,11 @@ from .engine import (  # noqa: F401
     DeadlineExceeded, Engine, EngineConfig, Overloaded, RequestCancelled,
     RequestHandle, ServeError,
 )
-from .pool import PagePool, TRASH_BLOCK  # noqa: F401
+from .pool import PagePool, SnapshotError, TRASH_BLOCK  # noqa: F401
 from .supervisor import ServingSupervisor  # noqa: F401
 
 __all__ = [
     "Engine", "EngineConfig", "RequestHandle", "ServeError",
     "RequestCancelled", "DeadlineExceeded", "Overloaded",
-    "ServingSupervisor", "PagePool", "TRASH_BLOCK",
+    "ServingSupervisor", "PagePool", "SnapshotError", "TRASH_BLOCK",
 ]

@@ -63,7 +63,18 @@ counters, and the engine registers a flight-recorder context provider so
 crash dumps carry the in-flight request table. Chaos points ``serve.crash``
 / ``serve.wedge`` / ``serve.slow_step`` / ``serve.pool_corrupt`` /
 ``hbm.oom`` / ``hbm.pressure`` (fault/inject.py) fire at the scheduler
-step boundary when armed.
+step boundary when armed; ``serve.snapshot_corrupt`` tears a state capture
+inside :meth:`Engine.snapshot` so adoption must fall back.
+
+Serving state durability (snapshot/adopt/handoff): the engine's whole live
+state — page pool bookkeeping, KV pool arrays, per-sequence block tables,
+and the prefix-cache chain — is capturable at a step boundary
+(:meth:`Engine.snapshot`), adoptable by a fresh engine
+(:meth:`Engine.adopt`: survivors resume mid-decode with ZERO re-prefilled
+tokens; a capture that fails validation falls back whole to re-prefill
+through the preemption/resume machinery), and transferable end-to-end by
+:meth:`Engine.handoff` (quiesce → export snapshot + queue + in-flight
+handles → successor adopts) — the zero-downtime restart/upgrade primitive.
 """
 from __future__ import annotations
 
@@ -82,12 +93,14 @@ from ..fault import inject as _inject
 from ..framework import flags
 from ..profiler import counter_inc, flight
 from ..profiler.spans import span
-from .pool import PagePool, TRASH_BLOCK
+from .pool import PagePool, SnapshotError, TRASH_BLOCK
 
 __all__ = [
     "Engine", "EngineConfig", "RequestHandle", "ServeError",
-    "RequestCancelled", "DeadlineExceeded", "Overloaded",
+    "RequestCancelled", "DeadlineExceeded", "Overloaded", "SnapshotError",
 ]
+
+SNAPSHOT_VERSION = 1  # engine-level snapshot format (pool has its own)
 
 _engine_ids = itertools.count(1)
 
@@ -589,6 +602,16 @@ class Engine:
         self._waiting: "collections.deque[_Request]" = collections.deque()  # guarded_by: _cv
         self._stop = False  # guarded_by: _cv
         self._draining = False  # guarded_by: _cv
+        # serving state durability: handoff() sets the request word and the
+        # scheduler consumes it at its next step boundary (quiesce, then the
+        # thread exits WITHOUT failing handles — the exported snapshot owns
+        # them). The unconfigured path costs one bool probe per iteration
+        # inside an already-held _cv block (inert tripwire). _last_recovery
+        # is the most recent adopt() outcome for health() probes (written
+        # once per adopt on the adopting thread, racy reads by design).
+        self._handoff_req = False  # guarded_by: _cv
+        self._quiesced = threading.Event()
+        self._last_recovery: Optional[dict] = None
         self._broken: Optional[BaseException] = None
         self._ids = itertools.count(1)
         # once-true latches (set under _cv, read lock-free by the scheduler):
@@ -755,6 +778,10 @@ class Engine:
             "queue_depth": depth,
             "running": len(self._running),
             "pages_free": self._pool.free_blocks,
+            # last adopt() outcome (reattach|reprefill), or mode "none":
+            # probes distinguish a degraded (re-prefill) recovery from clean
+            "last_recovery": (dict(self._last_recovery)
+                              if self._last_recovery else {"mode": "none"}),
         }
 
     def ready(self) -> bool:
@@ -836,6 +863,390 @@ class Engine:
             except Exception:  # lint: ok(oom-handler) — handle-state sweep, nothing dispatches in this try
                 pass
 
+    # -- serving state durability: snapshot / adopt / handoff -----------------
+    def _compat_key(self) -> tuple:
+        """Shape/dtype fingerprint an adopted snapshot must match exactly —
+        the KV pool arrays and block tables are only meaningful against the
+        same paged-cache geometry."""
+        cfg = self.config
+        return (self._n_layers, int(cfg.num_blocks), int(cfg.block_size),
+                int(self._arch["kv_heads"]), int(self._arch["head_dim"]),
+                str(self._dtype))
+
+    def snapshot(self) -> dict:
+        """O(blocks) consistent capture of the live serving state: pool
+        bookkeeping (with CRC), the KV pool arrays, every in-flight
+        sequence's tokens + block table, the prefix-cache chain, and
+        per-block KV content fingerprints.
+
+        Caller contract: the scheduler must be quiesced (``handoff``) or
+        dead (supervised crash — the loop's state is frozen) — a LIVE
+        scheduler would tear the capture, so this refuses one. The capture
+        shares the engine's immutable jnp arrays (cheap); on donating
+        backends discard it after ``adopt`` — the successor's first step
+        consumes the buffers."""
+        if self._thread.is_alive() and not self._quiesced.is_set() \
+                and not self._failed.is_set():
+            raise ServeError(
+                "snapshot requires a quiesced or dead scheduler thread "
+                "(use handoff(), or capture after a supervised crash)")
+        with span("serve_snapshot", step=self._step_i,
+                  running=len(self._running)) as sp:
+            pool_snap = self._pool.snapshot()
+            seqs, seen = [], set()
+            for phase, group in (("running", self._running),
+                                 ("resume", self._resume),
+                                 ("admitting", self._admitting)):
+                for s in group:
+                    if s.req.id in seen:
+                        continue  # landed mid-prefill: the _running view wins
+                    seen.add(s.req.id)
+                    seqs.append({"phase": phase, "req": s.req,
+                                 "tokens": list(s.tokens),
+                                 "blocks": list(s.blocks),
+                                 "prompt_len": s.prompt_len,
+                                 "cached_blocks": s.cached_blocks})
+            prefix = None
+            if self._prefix is not None:
+                prefix = {"entries": {k: list(v) for k, v
+                                      in self._prefix._entries.items()},
+                          "tick": self._prefix._tick}
+            owned = sorted(self._pool._owned)
+            sums = self._G.kv_block_checksums(self._kpool, self._vpool, owned)
+            snap = {"version": SNAPSHOT_VERSION, "compat": self._compat_key(),
+                    "pool": pool_snap, "kpool": self._kpool,
+                    "vpool": self._vpool, "seqs": seqs, "prefix": prefix,
+                    "step_i": self._step_i,
+                    "fingerprint": {"bids": owned, "sums": sums}}
+            if _inject.should_fire("serve.snapshot_corrupt"):
+                # chaos: tear the pool capture mid-write — the CRC no longer
+                # matches, and adopt()'s validation MUST reject it whole
+                if pool_snap["free"]:
+                    pool_snap["free"].pop()
+                else:
+                    pool_snap["ref"] = dict(pool_snap["ref"],
+                                            **{TRASH_BLOCK: 1})
+            sp.set(seqs=len(seqs), owned_blocks=len(owned))
+            counter_inc("serve_snapshots")
+            return snap
+
+    def adopt(self, snap: dict, only=None, fallback: str = "reprefill"):
+        """Adopt a :meth:`snapshot` into THIS (fresh, traffic-free) engine.
+
+        Validation first, mutation after: compat key, pool restore
+        (conservation + CRC), per-sequence block-table coverage, prefix
+        chain bijection/acyclicity, exact refcount↔mapping agreement, and
+        KV content fingerprints all must hold before any state is
+        installed. On success the survivors' ORIGINAL request objects go
+        straight into the running set — they resume mid-decode with zero
+        re-prefilled tokens and their existing handles/streams keep
+        working. A capture that fails validation raises
+        :class:`SnapshotError` when ``fallback="raise"``; with the default
+        ``fallback="reprefill"`` every in-flight record is re-admitted
+        whole through the preemption/resume machinery instead (re-prefill
+        from accumulated tokens — never worse than the PR 12 path).
+
+        ``only`` (set of request ids, or None for all) filters which
+        records are adopted; the rest have their block references released.
+        Returns an info dict: ``mode`` (reattach|reprefill), ``installed``
+        (request ids now owned by this engine), block/token counts, and
+        ``duration_s``."""
+        t0 = time.monotonic()
+        with span("serve_adopt", seqs=len(snap.get("seqs", ()))) as sp:
+            try:
+                pool = self._validate_snapshot(snap)
+                info = self._attach(snap, pool, only)
+            except SnapshotError as e:
+                counter_inc("serve_snapshot_rejected")
+                if fallback != "reprefill":
+                    raise
+                info = self._adopt_reprefill(snap, only)
+                info["reject_reason"] = str(e)
+            info["duration_s"] = round(time.monotonic() - t0, 6)
+            sp.set(mode=info["mode"])
+        self._last_recovery = info
+        counter_inc("serve_adoptions")
+        return info
+
+    def _validate_snapshot(self, snap: dict) -> PagePool:
+        """The extended check(): everything that must hold before adoption.
+        Raises SnapshotError; never mutates engine state."""
+        try:
+            version = snap.get("version")
+            compat = tuple(snap.get("compat", ()))
+            seqs = snap["seqs"]
+            prefix = snap.get("prefix")
+            kpool, vpool = snap["kpool"], snap["vpool"]
+            fp = snap["fingerprint"]
+        except Exception as e:  # lint: ok(oom-handler) — dict probing, nothing dispatches in this try
+            raise SnapshotError(f"malformed engine snapshot: {e!r}") from e
+        if version != SNAPSHOT_VERSION:
+            raise SnapshotError(
+                f"engine snapshot version {version!r} != {SNAPSHOT_VERSION}")
+        if compat != self._compat_key():
+            raise SnapshotError(
+                f"snapshot geometry {compat} does not match this engine's "
+                f"{self._compat_key()} — cross-config adoption refused")
+        if kpool.shape != self._kpool.shape or kpool.dtype != self._dtype \
+                or vpool.shape != self._vpool.shape:
+            raise SnapshotError("KV pool array shape/dtype mismatch")
+        pool = PagePool.restore(snap["pool"])
+        bs = self.config.block_size
+        refs: Dict[int, int] = {}
+        for rec in seqs:
+            blocks, tokens = rec["blocks"], rec["tokens"]
+            rid = rec["req"].id
+            if not tokens or len(tokens) < rec["prompt_len"]:
+                raise SnapshotError(f"seq {rid}: empty/short token record")
+            for b in blocks:
+                if b == TRASH_BLOCK or pool.refcount(b) < 1:
+                    raise SnapshotError(
+                        f"seq {rid} maps unowned block {b}")
+                refs[b] = refs.get(b, 0) + 1
+            if rec["phase"] == "running":
+                # written KV covers positions [0, pos): the table must too
+                if len(blocks) * bs < len(tokens) - 1:
+                    raise SnapshotError(
+                        f"seq {rid}: block table covers {len(blocks) * bs} "
+                        f"positions < written {len(tokens) - 1}")
+                if len(blocks) > self._max_blocks:
+                    raise SnapshotError(f"seq {rid}: table too wide")
+        if prefix is not None:
+            by_bid: Dict[int, tuple] = {}
+            kids: Dict[int, int] = {}
+            for key, ent in prefix["entries"].items():
+                bid = ent[0]
+                if bid in by_bid:
+                    raise SnapshotError(
+                        f"prefix index maps block {bid} twice")
+                if pool.refcount(bid) < 1:
+                    raise SnapshotError(
+                        f"prefix index holds unowned block {bid}")
+                by_bid[bid] = key
+                refs[bid] = refs.get(bid, 0) + 1
+            for key, ent in prefix["entries"].items():
+                parent = key[0]
+                if parent != -1:
+                    if parent not in by_bid:
+                        raise SnapshotError(
+                            f"prefix chain parent {parent} not in index")
+                    kids[parent] = kids.get(parent, 0) + 1
+                hops = 0
+                while parent != -1:
+                    parent = by_bid[parent][0]
+                    hops += 1
+                    if hops > len(by_bid):
+                        raise SnapshotError("prefix chain cycle")
+            for key, ent in prefix["entries"].items():
+                if ent[2] != kids.get(ent[0], 0):
+                    raise SnapshotError(
+                        f"prefix child-count diverged on block {ent[0]}")
+        # refcount ↔ mapping agreement must be EXACT: every owned block is
+        # referenced precisely refcount times by sequences + the index —
+        # any torn mid-mutation state (leaked alloc, half-finished retire,
+        # stale table) lands here and falls back instead of serving
+        for b in sorted(pool._owned):
+            if pool.refcount(b) != refs.get(b, 0):
+                raise SnapshotError(
+                    f"block {b}: pool refcount {pool.refcount(b)} != "
+                    f"{refs.get(b, 0)} mapped references")
+        # KV content fingerprints: the bytes the survivors will read must be
+        # the bytes the dead engine wrote — never a wrong-KV serve
+        if list(fp["bids"]) != sorted(pool._owned):
+            raise SnapshotError("fingerprint block set diverged from pool")
+        sums = self._G.kv_block_checksums(kpool, vpool, fp["bids"])
+        if not np.array_equal(sums, fp["sums"]):
+            raise SnapshotError("KV content fingerprint mismatch")
+        return pool
+
+    def _attach(self, snap: dict, pool: PagePool, only) -> dict:
+        """Install a validated snapshot (re-attach). Builds everything
+        off-lock against the restored local pool, then installs under _cv in
+        one notify — the idle scheduler thread picks the survivors up at its
+        next iteration."""
+        running, resume, installed = [], [], []
+        blocks_attached = tokens_saved = 0
+        max_id = 0
+        any_deadline = any_prio = False
+        for rec in snap["seqs"]:
+            req = rec["req"]
+            max_id = max(max_id, req.id)
+            if (only is not None and req.id not in only) \
+                    or req.done.is_set():
+                if rec["blocks"]:
+                    pool.free(rec["blocks"])
+                continue
+            s = _Seq(req, list(rec["tokens"]))
+            s.prompt_len = rec["prompt_len"]
+            if rec["phase"] == "running":
+                s.blocks = list(rec["blocks"])
+                s.cached_blocks = rec["cached_blocks"]
+                running.append(s)
+                blocks_attached += len(s.blocks)
+                tokens_saved += len(s.tokens)
+            else:
+                # resume/admitting rows re-prefill from accumulated tokens
+                # through the engine's own preemption machinery — exactly
+                # what an uninterrupted engine would have done with them
+                if rec["blocks"]:
+                    pool.free(rec["blocks"])
+                resume.append(s)
+            installed.append(req.id)
+            any_deadline |= req.deadline is not None
+            any_prio |= req.priority != 0
+        queue = []
+        for req in snap.get("queue", ()):
+            max_id = max(max_id, req.id)
+            if (only is not None and req.id not in only) \
+                    or req.done.is_set():
+                continue
+            queue.append(req)
+            installed.append(req.id)
+            any_deadline |= req.deadline is not None
+            any_prio |= req.priority != 0
+        # prefix index: rebind the chain to the restored pool when armed on
+        # both sides; otherwise release the index-held references so
+        # conservation holds without it
+        new_prefix = (None if self._prefix is None
+                      else _PrefixCache(pool, self.config.block_size))
+        if snap.get("prefix") is not None:
+            ps = snap["prefix"]
+            if new_prefix is not None:
+                new_prefix._entries = {k: list(v)
+                                       for k, v in ps["entries"].items()}
+                new_prefix._by_bid = {ent[0]: k for k, ent
+                                      in new_prefix._entries.items()}
+                new_prefix._tick = int(ps["tick"])
+            else:
+                bids = [ent[0] for ent in ps["entries"].values()]
+                if bids:
+                    pool.free(bids)
+        with self._cv:
+            if self._stop or self._draining or self._broken is not None:
+                raise ServeError("adopt: engine is stopped/draining/broken")
+            if self._step_i or self._running or self._resume \
+                    or self._admitting or self._waiting:
+                raise ServeError("adopt requires a fresh engine (no traffic)")
+            self._pool = pool
+            self._kpool = snap["kpool"]
+            self._vpool = snap["vpool"]
+            self._prefix = new_prefix
+            self._running.extend(running)
+            self._resume.extend(resume)
+            self._waiting.extend(queue)
+            if any_deadline:
+                self._deadline_seen = True
+            if any_prio:
+                self._has_prio = True
+            if max_id:
+                # adopted ids stay unique against future submissions (the
+                # supervisor's harvest dedup and spans key on req.id)
+                self._ids = itertools.count(max_id + 1)
+            self._cv.notify()
+        counter_inc("serve_reattached", len(running))
+        counter_inc("serve_reattached_blocks", blocks_attached)
+        counter_inc("serve_reprefill_tokens_saved", tokens_saved)
+        return {"mode": "reattach", "installed": sorted(installed),
+                "reattached": len(running), "resumed": len(resume),
+                "queued": len(queue), "blocks_reattached": blocks_attached,
+                "reprefill_tokens_saved": tokens_saved,
+                "reprefill_tokens": 0}
+
+    def _adopt_reprefill(self, snap: dict, only) -> dict:
+        """Whole-state fallback for a rejected snapshot: every in-flight
+        record becomes a resume entry (re-prefill from its accumulated
+        tokens into the ORIGINAL request/handle), queued requests re-queue.
+        No pool/KV state from the snapshot is trusted or touched."""
+        resume, queue, installed = [], [], []
+        tokens_reprefilled = 0
+        max_id = 0
+        any_deadline = any_prio = False
+        for rec in snap.get("seqs", ()):
+            req = rec["req"]
+            max_id = max(max_id, req.id)
+            if (only is not None and req.id not in only) \
+                    or req.done.is_set():
+                continue
+            s = _Seq(req, list(rec["tokens"]))
+            s.prompt_len = rec["prompt_len"]
+            resume.append(s)
+            installed.append(req.id)
+            tokens_reprefilled += len(s.tokens)
+            any_deadline |= req.deadline is not None
+            any_prio |= req.priority != 0
+        for req in snap.get("queue", ()):
+            max_id = max(max_id, req.id)
+            if (only is not None and req.id not in only) \
+                    or req.done.is_set():
+                continue
+            queue.append(req)
+            installed.append(req.id)
+            any_deadline |= req.deadline is not None
+            any_prio |= req.priority != 0
+        with self._cv:
+            if self._stop or self._draining or self._broken is not None:
+                raise ServeError("adopt: engine is stopped/draining/broken")
+            self._resume.extend(resume)
+            self._waiting.extend(queue)
+            if any_deadline:
+                self._deadline_seen = True
+            if any_prio:
+                self._has_prio = True
+            if max_id:
+                self._ids = itertools.count(max_id + 1)
+            self._cv.notify()
+        counter_inc("serve_reprefill_tokens", tokens_reprefilled)
+        return {"mode": "reprefill", "installed": sorted(installed),
+                "reattached": 0, "resumed": len(resume),
+                "queued": len(queue), "blocks_reattached": 0,
+                "reprefill_tokens_saved": 0,
+                "reprefill_tokens": tokens_reprefilled}
+
+    def handoff(self, timeout: float = 30.0) -> dict:
+        """Planned zero-downtime handoff: quiesce the scheduler at its next
+        step boundary, then export snapshot + queue + in-flight handles.
+
+        After this returns, THIS engine is terminally stopped (``submit``
+        raises, ``close()`` releases only plumbing — the handles live
+        inside the returned snapshot) and a successor adopts the snapshot:
+        ``new.adopt(old.handoff())``. Survivors resume mid-decode without
+        re-prefill; a validation failure falls back whole to re-prefill
+        inside ``adopt``. If the engine crashes before the quiesce lands,
+        this raises ``ServeError`` and the normal crash path owns the
+        handles (failed, or supervisor-recovered) — every interleaving
+        either completes the handoff or falls back whole."""
+        if threading.current_thread() is self._thread:
+            raise ServeError("handoff() cannot run on the scheduler thread")
+        with self._cv:
+            if self._stop or self._draining or self._broken is not None:
+                raise ServeError("handoff: engine is stopped/draining/broken")
+            if self._handoff_req:
+                raise ServeError("handoff already in progress")
+            self._handoff_req = True
+            self._cv.notify()
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        while not self._quiesced.wait(timeout=0.05):
+            if self._broken is not None or self._failed.is_set() \
+                    or not self._thread.is_alive():
+                raise ServeError(
+                    "engine failed before handoff quiesce"
+                ) from self._broken
+            if time.monotonic() > deadline:
+                raise ServeError(
+                    f"handoff quiesce timed out after {timeout}s")
+        # the loop exits right after signalling; join so the state is frozen
+        self._thread.join(max(1.0, deadline - time.monotonic()))
+        with span("serve_handoff", step=self._step_i):
+            snap = self.snapshot()
+            with self._cv:
+                snap["queue"] = list(self._waiting)
+                self._waiting.clear()
+            # the snapshot is the single owner of every handle now: clear
+            # the scheduler lists so close() cannot fail adopted streams
+            self._running, self._resume, self._admitting = [], [], []
+        counter_inc("serve_handoffs")
+        return snap
+
     def __enter__(self):
         return self
 
@@ -850,10 +1261,20 @@ class Engine:
             pass
 
     # ------------------------------------------------------- engine thread
-    def _run_once(self) -> bool:
-        """One scheduler iteration (bounded idle wait). True = stopped."""
+    def _run_once(self):
+        """One scheduler iteration (bounded idle wait). Truthy = stopped;
+        the ``"handoff"`` sentinel additionally tells the loop to exit
+        WITHOUT ``_shutdown`` — the handoff snapshot owns the handles."""
         self._beat = time.monotonic()  # heartbeat: health() / supervisor
         with self._cv:
+            if self._handoff_req and not self._stop:
+                # handoff quiesce: this is a step boundary (no _step in
+                # flight), so the capture is consistent by construction.
+                # _stop flips under the same lock, so submit() raises and a
+                # supervisor monitor sees a closed engine, never a crash.
+                self._stop = True
+                self._quiesced.set()
+                return "handoff"
             idle = not (self._waiting or self._running or self._resume)
             if self._draining and idle:
                 self._stop = True  # drain complete: fall through to stop
@@ -1783,6 +2204,9 @@ def _engine_loop(wr):
                     eng._shutdown()
             return
         if stopped:
-            eng._shutdown()
+            # handoff quiesce exits WITHOUT failing handles: the exported
+            # snapshot is their owner from here (Engine.handoff docstring)
+            if stopped != "handoff":
+                eng._shutdown()
             return
         del eng

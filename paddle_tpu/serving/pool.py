@@ -32,16 +32,38 @@ reference drops. Freeing an unowned id still raises (double-free), and
 ``park`` only ever draws from the free list, so a block with live
 references can structurally never be parked — PR 14's OOM pool-shrink is
 safe under sharing by construction.
+
+The bookkeeping is SNAPSHOTTABLE (serving state durability): ``snapshot``
+captures free list, ownership, refcounts, and parked set in O(blocks) plus
+a CRC over the canonical encoding, and ``restore`` rebuilds a pool from a
+capture — re-running ``check()`` plus structural validation so a torn or
+tampered snapshot surfaces as a structured :class:`SnapshotError`, never a
+silently-wrong allocator.
 """
 from __future__ import annotations
 
+import zlib
 from typing import Dict, List, Optional
 
 from ..profiler import counter_inc
 
-__all__ = ["PagePool", "TRASH_BLOCK"]
+__all__ = ["PagePool", "SnapshotError", "TRASH_BLOCK"]
 
 TRASH_BLOCK = 0
+
+POOL_SNAPSHOT_VERSION = 1
+
+
+class SnapshotError(RuntimeError):
+    """A serving-state snapshot failed validation (torn capture, tampering,
+    or an incompatible target) — callers fall back to re-prefill recovery
+    rather than serving from suspect KV state."""
+
+
+def _pool_crc(num_blocks: int, free, ref, parked) -> int:
+    payload = (num_blocks, tuple(free), tuple(sorted(ref.items())),
+               tuple(parked))
+    return zlib.crc32(repr(payload).encode())
 
 
 class PagePool:
@@ -179,3 +201,64 @@ class PagePool:
             )
         if any(c < 1 for c in self._ref.values()):
             raise RuntimeError("PagePool: owned block with refcount < 1")
+
+    # -- snapshot / restore (serving state durability) ----------------------
+
+    def snapshot(self) -> dict:
+        """O(blocks) consistent capture of the allocator bookkeeping.
+
+        Caller contract: taken at a scheduler step boundary (or from a dead
+        scheduler's frozen state) — the pool is engine-thread-only, so a
+        boundary capture is consistent by construction. The CRC covers the
+        canonical encoding; ``restore`` rejects any capture whose fields no
+        longer match it (torn or tampered snapshot)."""
+        snap = {
+            "version": POOL_SNAPSHOT_VERSION,
+            "num_blocks": self.num_blocks,
+            "free": list(self._free),
+            "ref": dict(self._ref),
+            "parked": list(self._parked),
+        }
+        snap["crc"] = _pool_crc(self.num_blocks, self._free, self._ref,
+                                self._parked)
+        return snap
+
+    @classmethod
+    def restore(cls, snap: dict) -> "PagePool":
+        """Rebuild a pool from a :meth:`snapshot` capture, or raise
+        :class:`SnapshotError`. Validation is the extended ``check()``:
+        CRC integrity, id ranges, duplicate detection, conservation, and
+        refcount↔ownership agreement all must hold — a capture that fails
+        any of them is rejected whole (the restored pool never escapes)."""
+        try:
+            if snap.get("version") != POOL_SNAPSHOT_VERSION:
+                raise SnapshotError(
+                    f"pool snapshot version {snap.get('version')!r} "
+                    f"!= {POOL_SNAPSHOT_VERSION}"
+                )
+            num_blocks = int(snap["num_blocks"])
+            free = [int(b) for b in snap["free"]]
+            ref = {int(b): int(c) for b, c in snap["ref"].items()}
+            parked = [int(b) for b in snap["parked"]]
+        except SnapshotError:
+            raise
+        except Exception as e:
+            raise SnapshotError(f"malformed pool snapshot: {e!r}") from e
+        if _pool_crc(num_blocks, free, ref, parked) != snap.get("crc"):
+            raise SnapshotError("pool snapshot CRC mismatch (torn capture)")
+        ids = free + list(ref) + parked
+        if any(b <= TRASH_BLOCK or b >= num_blocks for b in ids):
+            raise SnapshotError("pool snapshot: block id out of range")
+        if len(set(ids)) != len(ids):
+            raise SnapshotError("pool snapshot: block in two states at once")
+        pool = cls(num_blocks)
+        pool._free = free
+        pool._owned = set(ref)
+        pool._ref = ref
+        pool._parked = parked
+        try:
+            pool.check()
+        except RuntimeError as e:
+            raise SnapshotError(f"pool snapshot failed check(): {e}") from e
+        counter_inc("serve_pool_restores")
+        return pool

@@ -23,20 +23,29 @@ token. :class:`ServingSupervisor` wraps an :class:`~.engine.Engine` with:
   and in-flight sequences are **requeued**, mid-decode sequences continuing
   from their accumulated tokens through the engine's existing re-prefill
   path — greedy outputs stay **bit-identical** to an uninterrupted run
-  (sampled continuations are valid but re-seeded). After a WEDGE the
+  (sampled continuations are valid but re-seeded). With
+  ``FLAGS_serve_snapshot`` (or ``snapshot=True``) the crash path goes
+  further: the dead engine's frozen serving state is captured whole
+  (``Engine.snapshot`` — pool bookkeeping, KV arrays, block tables, prefix
+  chain) and the replacement **re-attaches** it (``Engine.adopt``) —
+  survivors resume mid-decode with ZERO re-prefilled tokens, still
+  bit-identical; a capture that fails validation (``SnapshotError``) falls
+  back to the requeue path above, so recovery is never worse than PR 12.
+  After a WEDGE the
   abandoned thread may still own its sequences, so in-flight work **fails**
   with a structured ``ServeError`` (never a hang) while queued requests —
-  untouched by the wedged loop — are requeued. ``max_restarts`` exhaustion
-  fails everything and marks the supervisor broken;
+  untouched by the wedged loop — are requeued (a live wedged thread could
+  tear a capture, so the snapshot path is crash-only). ``max_restarts``
+  exhaustion fails everything and marks the supervisor broken;
 * **probes + drain** — ``health()``/``ready()`` aggregate engine liveness
   with supervisor state for rolling-restart orchestration;
   ``close(drain=True)`` stops admission and completes outstanding work
   before stopping (the engine's drain mode).
 
 Chaos coverage: ``serve.crash`` / ``serve.wedge`` / ``serve.slow_step`` /
-``serve.pool_corrupt`` (fault/inject.py) drive the recovery paths in
-tests/test_serving_chaos.py; the tier-1 pins live in
-tests/test_serving_resilience.py.
+``serve.pool_corrupt`` / ``serve.snapshot_corrupt`` (fault/inject.py) drive
+the recovery paths in tests/test_serving_chaos.py; the tier-1 pins live in
+tests/test_serving_resilience.py and tests/test_serving_snapshot.py.
 """
 from __future__ import annotations
 
@@ -51,7 +60,8 @@ from ..framework import flags
 from ..profiler import counter_inc, flight
 from ..profiler.spans import span
 from .engine import (
-    DeadlineExceeded, Engine, RequestHandle, ServeError, _finish,
+    DeadlineExceeded, Engine, RequestHandle, ServeError, SnapshotError,
+    _finish,
 )
 
 __all__ = ["ServingSupervisor"]
@@ -172,10 +182,17 @@ class ServingSupervisor:
     engine and survive restarts)."""
 
     def __init__(self, model, config=None, max_restarts: int = 3,
-                 watchdog_s: Optional[float] = None, **overrides):
+                 watchdog_s: Optional[float] = None,
+                 snapshot: Optional[bool] = None, **overrides):
         self.watchdog_s = float(
             watchdog_s if watchdog_s is not None
             else flags.flag("FLAGS_serve_watchdog_s", 10.0))
+        # crash-recovery re-attach (serving state durability): resolved once
+        # at construction — the unconfigured path never reaches the
+        # snapshot/adopt code at all (inert tripwire)
+        self._snapshot = bool(
+            snapshot if snapshot is not None
+            else flags.flag("FLAGS_serve_snapshot", False))
         if self.watchdog_s < 1.0:
             # the engine's idle loop only refreshes its heartbeat every
             # 0.5s (cv.wait timeout): a sub-second staleness threshold
@@ -194,6 +211,9 @@ class ServingSupervisor:
         self._restarts = 0                              # guarded_by: _lock
         self._broken: Optional[BaseException] = None    # guarded_by: _lock
         self._relays: List[threading.Thread] = []       # guarded_by: _lock
+        # most recent recovery outcome for health() probes: mode is
+        # "none" | "reattach" | "reprefill"
+        self._last_recovery = {"mode": "none"}          # guarded_by: _lock
         self._stop = threading.Event()
         self._provider = f"serving_supervisor_{next(_sup_ids)}"
         wr = weakref.ref(self)
@@ -262,12 +282,16 @@ class ServingSupervisor:
         """Engine liveness + supervisor state; ``ok`` requires both."""
         with self._lock:
             eng, restarts, broken = self._engine, self._restarts, self._broken
+            last = dict(self._last_recovery)
         h = eng.health() if eng is not None else {"ok": False}
         h.update(
             restarts=restarts,
             max_restarts=self.max_restarts,
             watchdog_s=self.watchdog_s,
             supervisor_ok=broken is None,
+            # supervisor-level record wins over the engine's adopt()-local
+            # view: it also covers requeue-only and wedge recoveries
+            last_recovery=last,
         )
         h["ok"] = bool(h.get("ok") and broken is None)
         return h
@@ -319,6 +343,7 @@ class ServingSupervisor:
 
     # ------------------------------------------------------------- recovery
     def _recover(self, old: Engine, kind: str, err: BaseException) -> None:
+        t_detect = time.monotonic()  # restart-MTTR clock starts at detection
         with self._lock:
             if self._engine is not old or self._stop.is_set():
                 return  # stale detection: already recovered / closing
@@ -353,6 +378,23 @@ class ServingSupervisor:
                 old._watchdog.remove_unit(old._provider)
             except Exception:  # lint: ok(oom-handler) — store bookkeeping, nothing dispatches in this try
                 pass
+        # snapshot BEFORE the harvest empties the dead loop's lists: the
+        # capture walks _running/_resume/_admitting. Crash-only — a live
+        # wedged thread could tear it (Engine.snapshot refuses one anyway).
+        # Any capture failure degrades to the requeue path, never breaks
+        # the recovery itself.
+        snap = None
+        if self._snapshot and kind == "crash" and not exhausted:
+            try:
+                snap = old.snapshot()
+            except Exception as e:
+                from ..fault import memory as _mem
+
+                if _mem.is_oom(e):
+                    # the fingerprint reduction dispatches device work
+                    _mem.note_oom("serve.snapshot", e)
+                counter_inc("serve_snapshot_failed")
+                snap = None
         work = self._harvest(old, kind, err)
         if exhausted:
             for req, _prefix, why in work:
@@ -361,9 +403,9 @@ class ServingSupervisor:
                     f"{self.max_restarts} restarts: {err}"))
             return
         with span("supervise_restart", kind=kind, restarts=restarts,
-                  work=len(work)):
+                  work=len(work), snapshot=snap is not None):
             try:
-                self._restart(work, restarts)
+                info = self._restart(work, restarts, snap)
             except BaseException as e:
                 # the harvest already emptied the old engine's lists, so
                 # nothing else can ever finish these handles: a failed
@@ -374,9 +416,49 @@ class ServingSupervisor:
                     _finish(req, error=why or ServeError(
                         f"serving engine restart failed: {e!r}"))
                 raise  # the monitor records the supervisor as broken
+        dur = time.monotonic() - t_detect
+        counter_inc("serve_restart_mttr_ms", max(1, int(dur * 1000)))
+        rec = {
+            "mode": "none" if info is None
+            else ("reattach" if info.get("adopted") else "reprefill"),
+            "kind": kind,
+            "reattached": 0 if info is None else info.get("reattached", 0),
+            "blocks_reattached": (0 if info is None
+                                  else info.get("blocks_reattached", 0)),
+            "reprefill_tokens_saved": (
+                0 if info is None else info.get("reprefill_tokens_saved", 0)),
+            "requeued": 0 if info is None else info.get("requeued", 0),
+            "duration_s": round(dur, 6),
+        }
+        with self._lock:
+            self._last_recovery = rec
 
-    def _restart(self, work, restarts: int) -> None:
+    def _restart(self, work, restarts: int, snap=None):
+        """Spawn + install the replacement. With a snapshot in hand, the
+        replacement ADOPTS it first (strict — a ``SnapshotError`` falls back
+        to requeue for everything): re-attached requests are live in the new
+        engine under their ORIGINAL handles and need no relay; the rest go
+        through the PR 12 requeue + relay machinery. Returns a recovery info
+        dict (None when aborted by a racing close())."""
         new = self._spawn()
+        installed: set = set()
+        adopt_info = None
+        if snap is not None:
+            eligible = {req.id for req, _p, why in work if why is None}
+            try:
+                with span("serve_adopt_on_restart", restarts=restarts):
+                    adopt_info = new.adopt(snap, only=eligible,
+                                           fallback="raise")
+                installed = set(adopt_info["installed"])
+            except SnapshotError:
+                adopt_info = None  # serve_snapshot_rejected counted in adopt
+            except Exception as e:  # lint: ok(oom-handler) — classified below, fallback is the requeue path
+                from ..fault import memory as _mem
+
+                if _mem.is_oom(e):
+                    _mem.note_oom("serve.adopt", e)
+                counter_inc("serve_snapshot_failed")
+                adopt_info = None
         with self._lock:
             # close() may have raced this recovery (it only waits ~1s
             # for the monitor): installing the replacement after close()
@@ -389,13 +471,17 @@ class ServingSupervisor:
             for req, _prefix, why in work:
                 _finish(req, error=why or ServeError(
                     "serving supervisor closed during recovery"))
-            return
+            return None
         counter_inc("serve_restarts")
         pairs = []
+        requeued = 0
         for req, prefix, why in work:
             if why is not None:
                 _finish(req, error=why)
+            elif req.id in installed:
+                continue  # re-attached: live in the new engine, original handle
             else:
+                requeued += 1
                 pair = self._requeue(new, req, prefix)
                 if pair is not None:
                     pairs.append(pair)
@@ -407,6 +493,10 @@ class ServingSupervisor:
                 self._relays = [r for r in self._relays
                                 if r.is_alive()] + [t]
             t.start()
+        info = dict(adopt_info or {})
+        info["adopted"] = adopt_info is not None
+        info["requeued"] = requeued
+        return info
 
     def _harvest(self, old: Engine, kind: str,
                  err: BaseException) -> List[Tuple[object, Optional[list], Optional[BaseException]]]:
@@ -496,6 +586,10 @@ class ServingSupervisor:
                     else ServeError(f"requeue after restart failed: {e!r}"))
             return None
         counter_inc("serve_requeued")
+        if prefix is not None:
+            # mid-flight survivor going through re-prefill: the tokens the
+            # snapshot path would have saved (recovery-cost observability)
+            counter_inc("serve_reprefill_tokens", len(prompt))
         return (req, h)
 
     def _fail_all(self, err: BaseException) -> None:

@@ -6,7 +6,11 @@ every greedy stream bit-identical to an uninterrupted run), wedge detection
 inside the watchdog deadline, pool corruption contained to a restart, a
 straggling scheduler missing deadlines, and a 4x-overload storm that the
 engine must SHED (bounded admitted-latency, conserved pool) instead of
-stalling. Marked ``chaos`` like the PR 8 recovery suite: heavier multi-round
+stalling. The round-17 durability drives ride along: repeated crashes with
+snapshot re-attach armed, the prefix-chain restore under active sharing, a
+rolling engine→engine→engine handoff chain, and a crash racing the handoff
+quiesce — every interleaving completes or falls back whole, bit-identical.
+Marked ``chaos`` like the PR 8 recovery suite: heavier multi-round
 drives, opt-in via PADDLE_TPU_CHAOS=1 on the CPU tier; the single-shot
 tier-1 pins live in tests/test_serving_resilience.py.
 """
@@ -188,6 +192,124 @@ class TestSharingUnderChaos:
             st = eng.stats()
             assert st["pages_used"] == st["pages_cached"]
         assert profiler.counters().get("serve_preempted", 0) > preempted
+
+
+class TestDurabilityChaos:
+    def test_repeated_crashes_with_snapshot_stay_bit_identical(self, model):
+        """Sixteen greedy streams, the loop crashes TWICE with snapshot
+        recovery armed: both restarts RE-ATTACH (zero tokens re-prefilled
+        across the whole drive) and every stream is bit-identical to an
+        uninterrupted run — durability composes across repeated failures."""
+        rng = np.random.RandomState(20)
+        prompts = _prompts(16, rng)
+        with Engine(model, **_KW) as eng:
+            baseline = [eng.submit(p, max_new_tokens=10).result(timeout=600)
+                        for p in prompts]
+        c0 = profiler.counters().get("serve_reprefill_tokens", 0)
+        inject.arm({"serve.crash": {"at": 5}})
+        with ServingSupervisor(model, watchdog_s=5.0, snapshot=True,
+                               **_KW) as sup:
+            hs = [sup.submit(p, max_new_tokens=10) for p in prompts]
+            deadline = time.monotonic() + 60
+            while not inject.fired_counts().get("serve.crash") \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            inject.arm({"serve.crash": {"at": 7}})
+            outs = [h.result(timeout=600) for h in hs]
+            assert sup.restarts == 2
+            assert sup.health()["last_recovery"]["mode"] == "reattach"
+            assert sup.stats()["pages_used"] == 0
+        assert outs == baseline
+        assert profiler.counters().get("serve_reprefill_tokens", 0) == c0
+
+    def test_crash_mid_share_snapshot_restores_prefix_chain(self, model):
+        """The PR 16 + PR 17 composition under chaos: streams actively
+        sharing cached prefix blocks when the loop dies. The snapshot
+        carries the index's references and CoW refcounts; the restored pool
+        conserves with the chain intact and every stream stays
+        bit-identical with zero re-prefill."""
+        rng = np.random.RandomState(21)
+        shared = rng.randint(0, 211, (40,)).tolist()
+        prompts = [shared + rng.randint(0, 211,
+                                        (int(rng.randint(3, 10)),)).tolist()
+                   for _ in range(12)]
+        with Engine(model, **_KW) as eng:
+            baseline = [eng.submit(p, max_new_tokens=10).result(timeout=600)
+                        for p in prompts]
+        c0 = profiler.counters().get("serve_reprefill_tokens", 0)
+        inject.arm({"serve.crash": {"at": 5}})
+        with ServingSupervisor(model, watchdog_s=5.0, snapshot=True,
+                               prefix_cache=True, **_KW) as sup:
+            hs = [sup.submit(p, max_new_tokens=10) for p in prompts]
+            outs = [h.result(timeout=600) for h in hs]
+            assert sup.restarts == 1
+            st = sup.stats()
+            assert st["pages_used"] == st["pages_cached"]
+        assert outs == baseline
+        assert profiler.counters().get("serve_reprefill_tokens", 0) == c0
+
+    def test_handoff_chain_under_load(self, model):
+        """Rolling-upgrade drive: twelve live streams handed off engine →
+        engine → engine mid-decode. Each hop quiesces, adopts, and resumes
+        without re-prefill; the third engine finishes everything
+        bit-identical."""
+        rng = np.random.RandomState(22)
+        prompts = _prompts(12, rng)
+        with Engine(model, **_KW) as eng:
+            baseline = [eng.submit(p, max_new_tokens=12).result(timeout=600)
+                        for p in prompts]
+        c0 = profiler.counters().get("serve_reprefill_tokens", 0)
+        eng = Engine(model, **_KW)
+        hs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        try:
+            for _hop in range(2):
+                deadline = time.monotonic() + 60
+                while eng.stats()["decode_steps"] < 2 \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                snap = eng.handoff()
+                succ = Engine(model, **_KW)
+                info = succ.adopt(snap)
+                assert info["mode"] == "reattach"
+                eng.close()
+                eng = succ
+            outs = [h.result(timeout=600) for h in hs]
+            assert eng.stats()["pages_used"] == 0
+        finally:
+            eng.close()
+        assert outs == baseline
+        assert profiler.counters().get("serve_reprefill_tokens", 0) == c0
+
+    def test_crash_during_handoff_falls_back_whole(self, model):
+        """serve.crash lands between the handoff request and the quiesce:
+        handoff() must fail structurally (never a torn half-export), the
+        dying engine's handles fail or recover through the crash path, and
+        a fresh engine serves the same traffic bit-identical."""
+        rng = np.random.RandomState(23)
+        prompts = _prompts(6, rng)
+        with Engine(model, **_KW) as eng:
+            baseline = [eng.submit(p, max_new_tokens=8).result(timeout=600)
+                        for p in prompts]
+        old = Engine(model, **_KW)
+        try:
+            inject.arm("serve.crash:at=2")
+            hs = [old.submit(p, max_new_tokens=8) for p in prompts]
+            deadline = time.monotonic() + 60
+            while not inject.fired_counts().get("serve.crash") \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            with pytest.raises(ServeError):
+                old.handoff(timeout=10.0)
+            inject.disarm()
+            for h in hs:
+                with pytest.raises(ServeError):
+                    h.result(timeout=10)
+        finally:
+            old.close()
+        with Engine(model, **_KW) as new:
+            outs = [new.submit(p, max_new_tokens=8).result(timeout=600)
+                    for p in prompts]
+        assert outs == baseline
 
 
 class TestOverloadStorm:
