@@ -1423,7 +1423,190 @@ def bench_serving(paddle, jax, np, on_tpu):
         np, model, cfg.vocab_size, ekw, on_tpu)
     line["recovery"] = _bench_serving_recovery(np, model, ekw, prompts,
                                                max_new)
+    line["paged_kernel"] = _bench_serving_paged_kernel(
+        np, model, ekw, prompts, max_new)
     print("SERVE_PERF " + json.dumps(line))
+    return line
+
+
+def _bench_serving_paged_kernel(np, model, ekw, prompts, max_new):
+    """Decode A/B (ISSUE-18): the gather-then-dense paged read vs the
+    block-table-aware Pallas paged-attention kernel behind
+    ``FLAGS_serve_paged_kernel``, same prompts both arms. Reports per-arm
+    generated tokens/sec, the speedup, and whether the outputs stayed
+    bit-identical (the kernel's correctness contract — a False here is a
+    bug, not a perf note)."""
+    from paddle_tpu.framework import flags
+    from paddle_tpu.serving import Engine
+
+    sub = prompts[: min(16, len(prompts))]
+    arms, outs = {}, {}
+    for arm, on in (("gather", False), ("kernel", True)):
+        old = flags._FLAGS.get("FLAGS_serve_paged_kernel")
+        flags._FLAGS["FLAGS_serve_paged_kernel"] = on
+        try:
+            with Engine(model, **ekw) as eng:
+                warm = [eng.submit(p, max_new_tokens=max_new) for p in sub]
+                [h.result(timeout=600) for h in warm]
+                t0 = time.monotonic()
+                hs = [eng.submit(p, max_new_tokens=max_new) for p in sub]
+                res = [h.result(timeout=600) for h in hs]
+                wall = time.monotonic() - t0
+        finally:
+            if old is None:
+                flags._FLAGS.pop("FLAGS_serve_paged_kernel", None)
+            else:
+                flags._FLAGS["FLAGS_serve_paged_kernel"] = old
+        gen = sum(len(o) - len(p) for o, p in zip(res, sub))
+        arms[arm] = round(gen / max(wall, 1e-9), 1)
+        outs[arm] = res
+    return {
+        "streams": len(sub),
+        "gather_tokens_per_sec": arms["gather"],
+        "kernel_tokens_per_sec": arms["kernel"],
+        "speedup": round(arms["kernel"] / max(arms["gather"], 1e-9), 3),
+        "identical_tokens": outs["gather"] == outs["kernel"],
+    }
+
+
+def bench_kernel_autotune(paddle, jax, np, on_tpu):
+    """Kernel-registry autotune A/B (ISSUE-18): a real measured-timing
+    search over the flash-attention config space against a throwaway tuning
+    DB, then steady-state timing of the tuned config vs the pinned default,
+    a gather-vs-kernel paged-decode step A/B, and the DB hit/miss/search
+    accounting. Prints ONE `KERNEL_PERF` JSON line and returns the same
+    dict for extra_metrics. The run's tune dir is a temp dir — the
+    benchmark never pollutes (or benefits from) the user's cache."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    import paddle_tpu.models.generation as G
+    from paddle_tpu import profiler as _prof
+    from paddle_tpu.framework import flags
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.ops import kernels as K
+    from paddle_tpu.ops.kernels import autotune as _autotune
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_array
+
+    if on_tpu:
+        b, h, t, d, dtype = 1, 8, 8192, 128, jnp.bfloat16
+        samples, budget_s = 5, 120.0
+    else:
+        # interpret-mode Pallas is slow: small shape, few samples
+        b, h, t, d, dtype = 1, 2, 256, 32, jnp.float32
+        samples, budget_s = 2, 10.0
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, t, d), dtype)
+    kk = jnp.asarray(rng.randn(b, h, t, d), dtype)
+    v = jnp.asarray(rng.randn(b, h, t, d), dtype)
+
+    def time_fn(fn, *args):
+        jax.block_until_ready(fn(*args))
+        ts = []
+        for _ in range(samples):
+            t0 = time.monotonic()
+            jax.block_until_ready(fn(*args))
+            ts.append(time.monotonic() - t0)
+        ts.sort()
+        return ts[len(ts) // 2] * 1e3
+
+    def flash_with(cfg):
+        return jax.jit(lambda a, b_, c: flash_attention_array(
+            a, b_, c, causal=True, block_q=int(cfg["block_q"]),
+            block_k=int(cfg["block_k"])))
+
+    key = K.flash_attention_key(b, h, t, t, d, q.dtype, True)
+    default_cfg = dict(K.get_kernel("flash_attention").defaults)
+
+    tune_td = tempfile.mkdtemp(prefix="bench_tune_")
+    knobs = {"FLAGS_kernel_autotune": "search",
+             "FLAGS_kernel_tune_dir": tune_td,
+             "FLAGS_kernel_tune_samples": samples,
+             "FLAGS_kernel_tune_budget_s": budget_s}
+    old = {k_: flags._FLAGS.get(k_) for k_ in knobs}
+    try:
+        flags._FLAGS.update(knobs)
+        _autotune.clear_cache()
+        c0 = _prof.counters()
+        t0 = time.monotonic()
+        tuned_cfg = K.resolve_config("flash_attention", key)
+        search_s = time.monotonic() - t0
+        # rerun with a cold memo: must be a pure disk hit, zero re-search
+        _autotune.clear_cache()
+        K.resolve_config("flash_attention", key)
+        c1 = _prof.counters()
+    finally:
+        for k_, v_ in old.items():
+            if v_ is None:
+                flags._FLAGS.pop(k_, None)
+            else:
+                flags._FLAGS[k_] = v_
+        shutil.rmtree(tune_td, ignore_errors=True)
+        _autotune.clear_cache()
+
+    default_ms = time_fn(flash_with(default_cfg), q, kk, v)
+    tuned_ms = time_fn(flash_with(tuned_cfg), q, kk, v)
+
+    # paged decode: gather builder vs Pallas-kernel builder, one step
+    paddle.seed(0)
+    if on_tpu:
+        gcfg = GPTConfig(vocab_size=8192, hidden_size=512, num_layers=4,
+                         num_heads=8, max_position_embeddings=2048,
+                         hidden_dropout=0.0, attention_dropout=0.0)
+        B, BS, MB, NB = 64, 16, 16, 2048
+    else:
+        gcfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                         num_heads=2, max_position_embeddings=256,
+                         hidden_dropout=0.0, attention_dropout=0.0)
+        B, BS, MB, NB = 8, 8, 4, 64
+    model = GPTForPretraining(gcfg)
+    model.eval()
+    _, arch, params, _ = G.gpt_decode_state(model)
+    L, KV, D = len(params["layers"]), arch["kv_heads"], arch["head_dim"]
+    kpool = jnp.zeros((L, NB, BS, KV, D), jnp.float32)
+    vpool = jnp.zeros((L, NB, BS, KV, D), jnp.float32)
+    perm = rng.permutation(np.arange(1, NB))[: B * MB]
+    tables = jnp.asarray(perm.reshape(B, MB).astype(np.int32))
+    pos = jnp.asarray(rng.randint(0, BS * MB, (B,)).astype(np.int32))
+    toks = jnp.asarray(rng.randint(0, gcfg.vocab_size, (B,)).astype(np.int32))
+    temps = jnp.zeros((B,), jnp.float32)
+    pkey = jax.random.PRNGKey(0)
+    gather_fn = jax.jit(G.build_paged_decode(arch, B, BS, MB))
+    kernel_fn = jax.jit(G.build_paged_decode_kernel(arch, B, BS, MB))
+    args = (params, kpool, vpool, tables, pos, toks, temps, pkey)
+    gather_ms = time_fn(gather_fn, *args)
+    kernel_ms = time_fn(kernel_fn, *args)
+
+    def delta(name):
+        return c1.get(name, 0) - c0.get(name, 0)
+
+    line = {
+        "name": "kernel autotune A/B",
+        "flash": {
+            "shape": f"b{b} h{h} t{t} d{d} {np.dtype(dtype).name} causal",
+            "default_config": default_cfg, "tuned_config": tuned_cfg,
+            "default_ms": round(default_ms, 3),
+            "tuned_ms": round(tuned_ms, 3),
+            "speedup": round(default_ms / max(tuned_ms, 1e-9), 3),
+        },
+        "paged_decode": {
+            "shape": f"B{B} L{L} h{gcfg.hidden_size} blocks{MB}x{BS}",
+            "gather_ms": round(gather_ms, 3),
+            "kernel_ms": round(kernel_ms, 3),
+            "speedup": round(gather_ms / max(kernel_ms, 1e-9), 3),
+        },
+        "db": {"search_s": round(search_s, 2),
+               "searches": delta("kernel_tune_searches"),
+               "candidates": delta("kernel_tune_candidates"),
+               "hits": delta("kernel_tune_hits"),
+               "misses": delta("kernel_tune_misses"),
+               "rejects": delta("kernel_tune_db_rejects"),
+               "budget_stops": delta("kernel_tune_budget_stops")},
+    }
+    print("KERNEL_PERF " + json.dumps(line))
     return line
 
 
@@ -1677,7 +1860,8 @@ def main():
                bench_memory_pressure,
                bench_gpt_1p3b, bench_gpt_8k_flash,
                bench_vit_l_aot, bench_yolov3_aot, bench_llama_1b,
-               bench_dp8_gpt, bench_serving, bench_host_embedding):
+               bench_dp8_gpt, bench_serving, bench_host_embedding,
+               bench_kernel_autotune):
         if remaining() < 30.0:
             extras.append({"name": fn.__name__, "skipped": "budget"})
             continue
@@ -1780,6 +1964,10 @@ def main():
                     / max(counters.get("host_emb_hot_hits", 0)
                           + counters.get("host_emb_hot_misses", 0), 1), 4),
                 "host_emb_push_bytes": counters.get("host_emb_push_bytes", 0),
+                # kernel-autotune telemetry (ISSUE-18): DB hit/miss counts
+                # for the run — nonzero only when FLAGS_kernel_autotune ran
+                "kernel_tune_hits": counters.get("kernel_tune_hits", 0),
+                "kernel_tune_misses": counters.get("kernel_tune_misses", 0),
                 "platform": jax.devices()[0].platform,
                 "wall_s": round(time.time() - t_start, 1),
                 **({"error": gpt["error"]} if gpt.get("error") else {}),

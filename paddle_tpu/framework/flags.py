@@ -208,6 +208,27 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_xla_persistent_cache": True,
     "FLAGS_xla_persistent_cache_dir": "",
     "FLAGS_xla_persistent_cache_min_compile_secs": 0.5,
+    # Kernel autotuning (ops/kernels/). FLAGS_kernel_autotune: "off" makes
+    # resolve_config a pure dict probe returning each kernel's pinned
+    # defaults (byte-identical traces to the pre-registry call sites);
+    # "ondemand" reads persisted winners from the tuning DB but never
+    # searches; "search" runs a measured-timing search on a DB miss and
+    # persists the verified winner. FLAGS_kernel_tune_dir overrides the DB
+    # location (default ~/.cache/paddle_tpu/tune). Per-kernel search budget
+    # and timing samples: FLAGS_kernel_tune_budget_s (monotonic deadline),
+    # FLAGS_kernel_tune_samples (median-of-k, compile excluded).
+    "FLAGS_kernel_autotune": "off",
+    "FLAGS_kernel_tune_dir": "",
+    "FLAGS_kernel_tune_budget_s": 20.0,
+    "FLAGS_kernel_tune_samples": 5,
+    # Serving kernel kill-switches. FLAGS_serve_paged_kernel routes engine
+    # decode through the paged-attention Pallas kernel (reads K/V straight
+    # from PagePool blocks — bit-identical to the gather path; spec-decode
+    # keeps the gather). FLAGS_serve_int8_kernel keeps the int8 LM-head
+    # weight quantized end-to-end via the fused int8 matmul kernel instead
+    # of dequantizing it densely each step.
+    "FLAGS_serve_paged_kernel": False,
+    "FLAGS_serve_int8_kernel": False,
 }
 
 # Env pickup at import (reference: gflags env integration)

@@ -99,6 +99,23 @@ def _ln(x, w, b, eps=1e-5):
     return (x - mu) / jnp.sqrt(var + eps) * w + b
 
 
+def _head_mm(params, rows, key, transpose):
+    """LM-head matmul with an optional fused int8 path.
+
+    When the engine attached a quantized head (``params["head_q"]`` — see
+    serving/int8.attach_int8_head, behind FLAGS_serve_int8_kernel) the
+    weight stays int8 end-to-end through the fused dequant matmul kernel
+    (bit-identical to dequantize-then-matmul, so tokens cannot change).
+    Otherwise: the exact dense matmul these head fns always did."""
+    hq = params.get("head_q") if isinstance(params, dict) else None
+    if hq is not None:
+        from ..ops.kernels import int8_matmul
+
+        return int8_matmul(rows, hq["q"], hq["scale"], transpose_w=transpose)
+    w = params[key]
+    return rows @ (w.T if transpose else w)
+
+
 def _gpt_arch(H, D):
     def embed_prompt(params, ids, T0):
         return params["wte"][ids] + params["wpe"][jnp.arange(T0)][None]
@@ -116,11 +133,12 @@ def _gpt_arch(H, D):
         # batch-packed analogue of head()'s x[:, -1]
         h = _ln(x, params["lnf_w"], params["lnf_b"])
         rows = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
-        return rows @ params["wte"].T
+        return _head_mm(params, rows, "wte", True)
 
     def head_all(params, x):
         # logits at EVERY fed position (speculative verify reads all k+1)
-        return _ln(x, params["lnf_w"], params["lnf_b"]) @ params["wte"].T
+        return _head_mm(params, _ln(x, params["lnf_w"], params["lnf_b"]),
+                        "wte", True)
 
     def embed_tail(params, ids, starts):
         # T tokens per row at per-row absolute positions starts + [0..T)
@@ -171,6 +189,23 @@ def _gpt_arch(H, D):
         ff = jax.nn.gelu(h2 @ w["up_w"] + w["up_b"], approximate=True) @ w["down_w"] + w["down_b"]
         return x + ff, k_new, v_new
 
+    def qkv_rows(w, x, pos):
+        # the projection half of block_rows (same ops, same order — the
+        # kernel decode path must trace byte-identical math around the
+        # attention read): x (B,1,H·D) -> q (B,H,D), k_new/v_new (B,H,D)
+        B = x.shape[0]
+        h = _ln(x, w["ln1_w"], w["ln1_b"])
+        qkv = (h @ w["qkv_w"] + w["qkv_b"]).reshape(B, 1, 3, H, D)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        return q[:, 0], k[:, 0], v[:, 0]
+
+    def attn_out_rows(w, x, o):
+        # the post-attention half of block_rows: o (B,1,H·D) attention read
+        x = x + (o @ w["proj_w"] + w["proj_b"])
+        h2 = _ln(x, w["ln2_w"], w["ln2_b"])
+        ff = jax.nn.gelu(h2 @ w["up_w"] + w["up_b"], approximate=True) @ w["down_w"] + w["down_b"]
+        return x + ff
+
     def block(w, x, kv=None, pos=None):
         B, T = x.shape[0], x.shape[1]
         h = _ln(x, w["ln1_w"], w["ln1_b"])
@@ -193,12 +228,13 @@ def _gpt_arch(H, D):
 
     def head(params, x):
         x = _ln(x, params["lnf_w"], params["lnf_b"])
-        return x[:, -1] @ params["wte"].T  # tied head
+        return _head_mm(params, x[:, -1], "wte", True)  # tied head
 
     return {"embed_prompt": embed_prompt, "embed_token": embed_token,
             "embed_rows": embed_rows, "head_rows": head_rows,
             "head_all": head_all, "embed_tail": embed_tail,
             "block_rows": block_rows, "block_tail": block_tail,
+            "qkv_rows": qkv_rows, "attn_out_rows": attn_out_rows,
             "block": block, "head": head, "kv_heads": H, "head_dim": D}
 
 
@@ -281,10 +317,11 @@ def _llama_arch(H, KV, D, theta, eps):
     def head_rows(params, x, idx):
         h = _rms(x, params["lnf_w"], eps)
         rows = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
-        return rows @ params["head_w"]
+        return _head_mm(params, rows, "head_w", False)
 
     def head_all(params, x):
-        return _rms(x, params["lnf_w"], eps) @ params["head_w"]
+        return _head_mm(params, _rms(x, params["lnf_w"], eps),
+                        "head_w", False)
 
     def embed_tail(params, ids, starts):
         return params["wte"][ids]
@@ -330,6 +367,24 @@ def _llama_arch(H, KV, D, theta, eps):
         ff = (jax.nn.silu(h2 @ w["gate_w"]) * (h2 @ w["up_w"])) @ w["down_w"]
         return x + ff, k_new, v_new
 
+    def qkv_rows(w, x, pos):
+        # projection half of block_rows (same ops/order — see the GPT plug):
+        # RoPE at each row's own absolute position, un-repeated KV heads
+        B = x.shape[0]
+        h = _rms(x, w["ln1_w"], eps)
+        q = (h @ w["q_w"]).reshape(B, 1, H, D)
+        k = (h @ w["k_w"]).reshape(B, 1, KV, D)
+        v = (h @ w["v_w"]).reshape(B, 1, KV, D)
+        q = _rope_rows(q, pos, theta)
+        k = _rope_rows(k, pos, theta)
+        return q[:, 0], k[:, 0], v[:, 0]
+
+    def attn_out_rows(w, x, o):
+        x = x + o @ w["o_w"]
+        h2 = _rms(x, w["ln2_w"], eps)
+        ff = (jax.nn.silu(h2 @ w["gate_w"]) * (h2 @ w["up_w"])) @ w["down_w"]
+        return x + ff
+
     def block(w, x, kv=None, pos=None):
         B, T = x.shape[0], x.shape[1]
         h = _rms(x, w["ln1_w"], eps)
@@ -355,12 +410,14 @@ def _llama_arch(H, KV, D, theta, eps):
         return x + ff, new_kv
 
     def head(params, x):
-        return _rms(x, params["lnf_w"], eps)[:, -1] @ params["head_w"]
+        return _head_mm(params, _rms(x, params["lnf_w"], eps)[:, -1],
+                        "head_w", False)
 
     return {"embed_prompt": embed_prompt, "embed_token": embed_token,
             "embed_rows": embed_rows, "head_rows": head_rows,
             "head_all": head_all, "embed_tail": embed_tail,
             "block_rows": block_rows, "block_tail": block_tail,
+            "qkv_rows": qkv_rows, "attn_out_rows": attn_out_rows,
             "block": block, "head": head, "kv_heads": KV, "head_dim": D}
 
 
@@ -737,6 +794,46 @@ def build_paged_decode(arch, B, block_size, max_blocks):
                                                  live, pos)
             kpool = kpool.at[li, bids, offs].set(k_new)
             vpool = vpool.at[li, bids, offs].set(v_new)
+        logits = arch["head"](params, x)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = (logits / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.float32)
+        sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(temps > 0, sampled, greedy)
+        return kpool, vpool, nxt
+
+    return step
+
+
+def build_paged_decode_kernel(arch, B, block_size, max_blocks):
+    """``build_paged_decode`` with the attention read done by the
+    block-table-aware Pallas kernel (``ops/kernels/paged_attention``)
+    instead of the gather-then-dense path. Same step signature, same
+    sampling — a drop-in the engine selects behind FLAGS_serve_paged_kernel.
+
+    Differences from the gather builder, neither visible in the output:
+    - no ``kpool[li][tables]`` HBM materialization — the kernel DMAs each
+      row's blocks straight out of the pool;
+    - the fresh K/V is scattered into the pool BEFORE the kernel reads it
+      (the gather path overwrites the gathered copy at ``pos`` in-context —
+      same values land in the same slot, so attention sees identical state).
+    The surrounding per-layer math is the same ``block_rows`` code factored
+    into ``qkv_rows``/``attn_out_rows``, so the whole step is bit-identical
+    to the gather builder on the CPU tier (kernel in interpret mode)."""
+    KV, D = arch["kv_heads"], arch["head_dim"]
+
+    def step(params, kpool, vpool, tables, pos, toks, temps, key):
+        from ..ops.kernels import paged_attention_rows
+
+        layer_ws = params["layers"]
+        x = arch["embed_rows"](params, toks, pos)
+        bids = jnp.take_along_axis(tables, (pos // block_size)[:, None], axis=1)[:, 0]
+        offs = pos % block_size
+        for li, w in enumerate(layer_ws):
+            q, k_new, v_new = arch["qkv_rows"](w, x, pos)
+            kpool = kpool.at[li, bids, offs].set(k_new)
+            vpool = vpool.at[li, bids, offs].set(v_new)
+            o = paged_attention_rows(q, kpool[li], vpool[li], tables, pos)
+            x = arch["attn_out_rows"](w, x, o[:, None])
         logits = arch["head"](params, x)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         scaled = (logits / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.float32)

@@ -420,23 +420,28 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
     return (loss, sm) if return_softmax else loss
 
 
-def fused_linear_cross_entropy(x, weight, label, block_rows=2048, ignore_index=-100):
+def fused_linear_cross_entropy(x, weight, label, block_rows=None, ignore_index=-100):
     """LM-head projection + softmax cross-entropy WITHOUT materializing the
     (N, vocab) logits tensor (see ops/fused_ce.py; role of the reference's
     c_softmax_with_cross_entropy fused op). x: (..., d); weight: (V, d);
-    label: int (...,). Returns scalar mean loss over non-ignored rows."""
+    label: int (...,). Returns scalar mean loss over non-ignored rows.
+    ``block_rows=None`` resolves the row-block size through the kernel
+    registry (pinned 2048 default with autotune off)."""
     from ...ops.fused_ce import fused_linear_cross_entropy as _fce
 
     xt, wt, yt = as_tensor(x), as_tensor(weight), as_tensor(label)
     d = xt.shape[-1]
 
-    def fn(xa, wa, ya, block_rows=2048, ignore_index=-100):
+    def fn(xa, wa, ya, block_rows=0, ignore_index=-100):
         return _fce(
             xa.reshape(-1, d), wa, ya.reshape(-1).astype(jnp.int32),
-            block_rows, ignore_index,
+            block_rows or None, ignore_index,
         )
 
+    # attrs ride the eager-call cache key, so the registry sentinel is the
+    # int 0 (= resolve at trace time), never a None
     return eager_call(
         "fused_linear_cross_entropy", fn, [xt, wt, yt],
-        attrs={"block_rows": int(block_rows), "ignore_index": int(ignore_index)},
+        attrs={"block_rows": 0 if block_rows is None else int(block_rows),
+               "ignore_index": int(ignore_index)},
     )

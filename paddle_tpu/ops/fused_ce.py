@@ -32,15 +32,31 @@ def _block(x, labels, block_rows):
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def fused_linear_cross_entropy(x, w, labels, block_rows=2048, ignore_index=-100):
+def _fce_call(x, w, labels, block_rows, ignore_index):
+    loss, _ = _fce_fwd(x, w, labels, block_rows, ignore_index)
+    return loss
+
+
+def fused_linear_cross_entropy(x, w, labels, block_rows=None, ignore_index=-100):
     """mean over valid rows of CE(softmax(x @ w.T), labels).
 
     x: (N, d); w: (V, d) — the (tied) LM-head/embedding weight; labels: (N,)
     int. Rows where ``labels == ignore_index`` (or padding) are excluded
     from both the sum and the mean denominator.
+
+    ``block_rows=None`` resolves through the kernel registry
+    (``ops/kernels``: the pinned 2048 default with autotune off, a tuned
+    winner otherwise); an explicit value bypasses the registry. Resolution
+    is trace-time python — the traced program always sees a concrete block.
     """
-    loss, _ = _fce_fwd(x, w, labels, block_rows, ignore_index)
-    return loss
+    if block_rows is None:
+        from .kernels import fused_ce_key, resolve_config
+
+        cfg = resolve_config(
+            "fused_ce", fused_ce_key(x.shape[0], x.shape[1], w.shape[0],
+                                     x.dtype))
+        block_rows = int(cfg["block_rows"])
+    return _fce_call(x, w, labels, int(block_rows), ignore_index)
 
 
 def _fce_fwd(x, w, labels, block_rows, ignore_index):
@@ -92,4 +108,4 @@ def _fce_bwd(block_rows, ignore_index, res, ct):
     return dx, dw.astype(w.dtype), dlabels
 
 
-fused_linear_cross_entropy.defvjp(_fce_fwd, _fce_bwd)
+_fce_call.defvjp(_fce_fwd, _fce_bwd)

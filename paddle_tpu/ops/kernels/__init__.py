@@ -1,0 +1,35 @@
+"""Autotuned kernel registry (ROADMAP item 6).
+
+Public surface:
+
+- :func:`register_kernel` / :func:`get_kernel` / :func:`kernel_names` /
+  :func:`resolve_config` — the registry (``registry.py``);
+- ``autotune`` / ``db`` submodules — the measured-timing search and the
+  persistent tuning DB; imported lazily by ``resolve_config`` only when
+  ``FLAGS_kernel_autotune`` is ``ondemand``/``search``, so with the default
+  ``off`` this package costs one dict probe per trace and nothing else;
+- kernel modules — ``paged_attention`` and ``int8_matmul`` (new Pallas
+  kernels for serving), plus ``builtin`` (registry specs hoisting the
+  frozen flash-attention / fused-CE block constants into defaults).
+
+Importing this package registers every built-in spec. It must NOT import
+``autotune``/``db`` at import time (the inert-layer contract).
+"""
+from __future__ import annotations
+
+from .registry import (KernelSpec, get_kernel, kernel_names, register_kernel,
+                       resolve_config)
+from . import builtin  # noqa: F401  (registers flash_attention, fused_ce)
+from . import paged_attention  # noqa: F401
+from . import int8_matmul as int8_matmul_mod  # noqa: F401
+from .paged_attention import paged_attention_key, paged_attention_rows
+from .int8_matmul import int8_matmul, int8_matmul_key
+from .builtin import flash_attention_key, fused_ce_key
+
+__all__ = [
+    "KernelSpec", "register_kernel", "get_kernel", "kernel_names",
+    "resolve_config",
+    "paged_attention_rows", "paged_attention_key",
+    "int8_matmul", "int8_matmul_key",
+    "flash_attention_key", "fused_ce_key",
+]

@@ -1,0 +1,190 @@
+"""Measured-timing autotuner for registered kernels.
+
+``resolve`` (the only entry the registry calls) checks the in-process memo,
+then the on-disk tuning DB, then — in ``search`` mode — runs a real search:
+
+- candidates are the cartesian product of the kernel's declared config
+  space, filtered by the spec's validity predicate and ORDERED by
+  ``cost_model.CostModel.kernel_estimate`` (the analytic flops/bytes/
+  program-overhead model calibrated against XLA ``cost_analysis`` numbers),
+  so plausible configs are visited first under the per-kernel time budget
+  (``FLAGS_kernel_tune_budget_s``, a monotonic-clock deadline);
+- each candidate is timed with median-of-k wall samples
+  (``FLAGS_kernel_tune_samples``) with the FIRST call excluded — that call
+  compiles, and compile time must never leak into a steady-state ranking;
+- a candidate can only win if :func:`verify` accepts its output against the
+  DEFAULT config's output (dtype-scaled allclose + same finite mask) — the
+  default is always measured first, so the result is never worse than the
+  pinned defaults: a verified faster winner, or the defaults themselves.
+
+Winners persist via ``db.store`` (atomic write); a later process resolves
+them straight from disk with zero re-search (``kernel_tune_hits``).
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ...framework import flags
+from ...profiler import counter_inc
+from ...profiler.spans import span
+from . import db
+
+__all__ = ["resolve", "search", "candidates", "verify", "clear_cache"]
+
+# in-process memo of resolved configs: (kernel, key) -> config. One disk
+# probe (and at most one search) per shape bucket per process.
+_MEM: Dict[tuple, dict] = {}
+
+
+def clear_cache():
+    _MEM.clear()
+
+
+def _config_in_space(spec, config: dict) -> bool:
+    """A DB entry is only trusted if every field names a declared axis with
+    a declared choice (defaults count) — a schema-drifted or hand-edited
+    config is rejected, never traced."""
+    for k, v in config.items():
+        if k in spec.defaults and v == spec.defaults[k]:
+            continue
+        if k not in spec.space or v not in spec.space[k]:
+            return False
+    return set(config) == set(spec.defaults)
+
+
+def resolve(spec, key: tuple, mode: str) -> dict:
+    memo_key = (spec.name, key)
+    cached = _MEM.get(memo_key)
+    if cached is not None:
+        return dict(cached)
+    config = db.lookup(spec.name, key)
+    if config is not None and not _config_in_space(spec, config):
+        counter_inc("kernel_tune_db_rejects")
+        db.delete(spec.name, key)
+        config = None
+    if config is not None:
+        counter_inc("kernel_tune_hits")
+        _MEM[memo_key] = dict(config)
+        return dict(config)
+    counter_inc("kernel_tune_misses")
+    if mode == "search" and spec.runner is not None:
+        config, best_ms, default_ms, searched = search(spec, key)
+        if searched:
+            db.store(spec.name, key, config, best_ms, default_ms)
+        _MEM[memo_key] = dict(config)
+        return dict(config)
+    # ondemand miss (or un-runnable kernel): the pinned defaults
+    _MEM[memo_key] = dict(spec.defaults)
+    return dict(spec.defaults)
+
+
+def candidates(spec, key: tuple):
+    """Non-default configs in cost-model order (cheapest estimate first)."""
+    from ...cost_model import CostModel
+
+    names = sorted(spec.space)
+    cands = []
+    for combo in itertools.product(*(spec.space[n] for n in names)):
+        cfg = dict(spec.defaults)
+        cfg.update(zip(names, combo))
+        if cfg == dict(spec.defaults):
+            continue
+        if spec.valid is not None and not spec.valid(cfg, key):
+            continue
+        if cfg not in cands:
+            cands.append(cfg)
+    cm = CostModel()
+    cands.sort(key=lambda c: cm.kernel_estimate(spec.name, key, c))
+    return cands
+
+
+def verify(out, ref) -> bool:
+    """Accept a candidate's output only if it matches the default config's
+    output: same tree/shape/dtype, same finite mask, values within a
+    dtype-scaled tolerance (block-size changes reorder float accumulation
+    by a few ulps; anything beyond tolerance is a broken config)."""
+    import jax
+
+    la = jax.tree_util.tree_leaves(out)
+    lb = jax.tree_util.tree_leaves(ref)
+    if len(la) != len(lb):
+        return False
+    for a, b in zip(la, lb):
+        a = np.asarray(a)
+        b = np.asarray(b)
+        if a.shape != b.shape or a.dtype != b.dtype:
+            return False
+        af = a.astype(np.float64)
+        bf = b.astype(np.float64)
+        if not np.array_equal(np.isfinite(af), np.isfinite(bf)):
+            return False
+        tol = 2e-2 if a.dtype.itemsize <= 2 else 1e-5
+        fin = np.isfinite(bf)
+        if not np.allclose(af[fin], bf[fin], rtol=tol, atol=tol):
+            return False
+    return True
+
+
+def _measure(make: Callable[[dict], Callable[[], Any]], config: dict,
+             samples: int) -> Tuple[Optional[Any], Optional[float]]:
+    """(output, median ms over ``samples`` runs); first call excluded — it
+    compiles, and compile time must not rank steady-state configs."""
+    import jax
+
+    step = make(dict(config))
+    out = step()
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(max(int(samples), 1)):
+        t0 = time.monotonic()
+        o = step()
+        jax.block_until_ready(o)
+        times.append(time.monotonic() - t0)
+    times.sort()
+    return out, times[len(times) // 2] * 1e3
+
+
+def search(spec, key: tuple):
+    """Returns ``(config, best_ms, default_ms, searched)``. ``searched`` is
+    False when even the default config failed to run (nothing to persist)."""
+    budget_s = float(flags.flag("FLAGS_kernel_tune_budget_s", 20.0))
+    samples = int(flags.flag("FLAGS_kernel_tune_samples", 5))
+    deadline = time.monotonic() + budget_s
+    make = spec.runner(key)
+    counter_inc("kernel_tune_searches")
+    with span("kernel_tune", kernel=spec.name) as sp:
+        try:
+            ref_out, default_ms = _measure(make, spec.defaults, samples)
+        except Exception:
+            # a broken runner degrades to the pinned defaults; it must never
+            # take the call site down
+            counter_inc("kernel_tune_candidate_errors")
+            sp.set(result="default_failed")
+            return dict(spec.defaults), None, None, False
+        best_cfg, best_ms = dict(spec.defaults), default_ms
+        tried = 0
+        for cfg in candidates(spec, key):
+            if time.monotonic() >= deadline:
+                counter_inc("kernel_tune_budget_stops")
+                break
+            tried += 1
+            counter_inc("kernel_tune_candidates")
+            try:
+                out, ms = _measure(make, cfg, samples)
+            except Exception:
+                # an invalid config failing to trace/compile just
+                # disqualifies it
+                counter_inc("kernel_tune_candidate_errors")
+                continue
+            if not verify(out, ref_out):
+                counter_inc("kernel_tune_verify_fails")
+                continue
+            if ms < best_ms:
+                best_cfg, best_ms = dict(cfg), ms
+        sp.set(candidates=tried, default_ms=default_ms, best_ms=best_ms,
+               tuned=best_cfg != dict(spec.defaults))
+    return best_cfg, best_ms, default_ms, True

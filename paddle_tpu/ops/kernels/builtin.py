@@ -1,0 +1,114 @@
+"""Registry specs for the pre-existing tunable kernels.
+
+These hoist the block-size constants that were frozen at the flash-attention
+and fused-CE call sites into registry DEFAULTS — the values here must stay
+equal to the constants that shipped before the registry existed, because
+with ``FLAGS_kernel_autotune=off`` the call sites must trace byte-identical
+HLO to HEAD. The kernel implementations stay where they are
+(``ops/pallas/flash_attention.py``, ``ops/fused_ce.py``); runners import
+them lazily so registering a spec never pulls in pallas.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_kernel
+
+__all__ = ["flash_attention_key", "fused_ce_key"]
+
+
+def _pow2(n: int) -> int:
+    m = 1
+    while m < int(n):
+        m *= 2
+    return m
+
+
+def flash_attention_key(b, h, t, t_kv, d, dtype, causal) -> tuple:
+    """(batch*heads pow2-bucketed, heads, q len, kv len, head dim, dtype,
+    causal) — lengths stay exact because block divisibility depends on them.
+    """
+    return (_pow2(int(b) * int(h)), int(h), int(t), int(t_kv), int(d),
+            str(jnp.dtype(dtype)), bool(causal))
+
+
+def _flash_runner(key):
+    import numpy as np
+
+    from ..pallas.flash_attention import flash_attention_array
+
+    bh, h, t, t_kv, d, dtype, causal = key
+    b = max(bh // h, 1)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, t, d), dtype)
+    k = jnp.asarray(rng.randn(b, h, t_kv, d), dtype)
+    v = jnp.asarray(rng.randn(b, h, t_kv, d), dtype)
+
+    def make(config):
+        fn = jax.jit(functools.partial(
+            flash_attention_array, causal=causal,
+            block_q=int(config["block_q"]), block_k=int(config["block_k"])))
+        return lambda: fn(q, k, v)
+
+    return make
+
+
+register_kernel(
+    "flash_attention",
+    # the frozen flash_attention_array signature defaults at registry birth
+    defaults={"block_q": 512, "block_k": 512},
+    space={"block_q": (128, 256, 512, 1024),
+           "block_k": (128, 256, 512, 1024)},
+    runner=_flash_runner,
+    # _pick_block degrades any requested block to a divisor of t, so every
+    # declared choice traces for every key
+    valid=None,
+)
+
+
+def fused_ce_key(n, d, v, dtype) -> tuple:
+    """(rows pow2-bucketed, hidden, vocab, dtype). Rows bucket because the
+    scan pads the last block anyway; d and V set the block-logits footprint
+    and stay exact."""
+    return (_pow2(int(n)), int(d), int(v), str(jnp.dtype(dtype)))
+
+
+def _fce_runner(key):
+    import numpy as np
+
+    from ..fused_ce import fused_linear_cross_entropy
+
+    n, d, v, dtype = key
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n, d), dtype)
+    w = jnp.asarray(rng.randn(v, d), dtype)
+    labels = jnp.asarray(rng.randint(0, v, size=(n,)), jnp.int32)
+
+    def make(config):
+        br = int(config["block_rows"])
+
+        @jax.jit
+        def step():
+            # time the full train-step shape: forward + both grads (the
+            # backward rematerializes block logits, so block_rows matters
+            # twice)
+            return jax.value_and_grad(
+                lambda xx, ww: fused_linear_cross_entropy(
+                    xx, ww, labels, br), argnums=(0, 1))(x, w)
+
+        return step
+
+    return make
+
+
+register_kernel(
+    "fused_ce",
+    # the frozen fused_linear_cross_entropy block_rows default
+    defaults={"block_rows": 2048},
+    space={"block_rows": (512, 1024, 2048, 4096, 8192)},
+    runner=_fce_runner,
+    valid=None,
+)

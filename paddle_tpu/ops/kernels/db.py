@@ -1,0 +1,122 @@
+"""On-disk kernel tuning DB: verified autotune winners that survive restarts.
+
+Layout: one JSON file per (kernel, shape-bucket key, platform, jax version)
+under ``~/.cache/paddle_tpu/tune/`` (override: ``FLAGS_kernel_tune_dir``),
+named ``<kernel>-<sha1[:16] of the canonical key>.json`` — keyed like the
+XLA executable cache, so a DB written on one platform/jax can never leak a
+config onto another.
+
+Durability contract (the PR-3 torn-cache incident class must be impossible
+here): every write goes through ``framework.io.atomic_open`` (tmp +
+``os.replace``), and every read re-derives a sha1 checksum over the payload
+body and re-checks every key field. A torn, truncated, hand-edited or
+stale-keyed entry is a *structured reject* — counted
+(``kernel_tune_db_rejects``), the bad file removed, and the lookup reported
+as a miss so ``search`` mode re-tunes and ``off``/``ondemand`` fall back to
+the pinned defaults. A wrong config is never returned; deleting the DB dir
+is always safe (silent fallback to defaults).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+from ...framework import flags
+from ...framework.io import atomic_open
+from ...profiler import counter_inc
+
+__all__ = ["tune_dir", "entry_path", "store", "lookup", "delete"]
+
+_SCHEMA = 1
+
+
+def tune_dir() -> str:
+    d = flags.flag("FLAGS_kernel_tune_dir", "") or ""
+    return d or os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                             "tune")
+
+
+def _platform() -> str:
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "unknown"
+
+
+def _canon_key(key: tuple):
+    # JSON round-trip canonicalization: tuples become lists, so a stored key
+    # compares equal to a live one after one encode/decode
+    return json.loads(json.dumps(list(key)))
+
+
+def _body(name: str, key: tuple) -> dict:
+    import jax
+
+    return {"schema": _SCHEMA, "kernel": name, "key": _canon_key(key),
+            "platform": _platform(), "jax": jax.__version__}
+
+
+def _digest(body: dict) -> str:
+    return hashlib.sha1(
+        json.dumps(body, sort_keys=True).encode()).hexdigest()
+
+
+def entry_path(name: str, key: tuple) -> str:
+    tag = _digest(_body(name, key))[:16]
+    safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in name)
+    return os.path.join(tune_dir(), f"{safe}-{tag}.json")
+
+
+def store(name: str, key: tuple, config: dict, best_ms: Optional[float],
+          default_ms: Optional[float]) -> str:
+    body = _body(name, key)
+    body.update(config=dict(config),
+                best_ms=best_ms, default_ms=default_ms)
+    payload = dict(body, checksum=_digest(body))
+    path = entry_path(name, key)
+    os.makedirs(tune_dir(), exist_ok=True)
+    with atomic_open(path, "w") as f:
+        json.dump(payload, f, sort_keys=True, indent=1)
+    return path
+
+
+def lookup(name: str, key: tuple) -> Optional[dict]:
+    """The winner config for ``key``, or None on a miss OR a rejected
+    (torn/corrupt/mismatched) entry — a wrong config is never returned."""
+    path = entry_path(name, key)
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError:
+        return None  # plain miss
+    try:
+        payload = json.loads(raw)
+        checksum = payload.pop("checksum")
+        if checksum != _digest(payload):
+            raise ValueError("checksum mismatch")
+        expect = _body(name, key)
+        for field, want in expect.items():
+            if payload.get(field) != want:
+                raise ValueError(f"key field {field!r} mismatch")
+        config = payload["config"]
+        if not isinstance(config, dict):
+            raise ValueError("config is not a dict")
+        return config
+    except (ValueError, KeyError, TypeError, AttributeError):
+        # torn/truncated/hand-edited/stale entry: structured reject — count,
+        # drop the bad file, report a miss (search re-tunes; off/ondemand
+        # fall back to the pinned defaults)
+        counter_inc("kernel_tune_db_rejects")
+        delete(name, key)
+        return None
+
+
+def delete(name: str, key: tuple) -> None:
+    try:
+        os.remove(entry_path(name, key))
+    except OSError:
+        pass

@@ -1,0 +1,89 @@
+"""Kernel registry: tunable Pallas kernels behind one config-resolution door.
+
+Every registered kernel declares (a) a correctness-pinned **default config**
+equal to the constants that were hand-frozen at its call site before this
+layer existed, and (b) a **config space** of tunable axes (block sizes,
+score/pipelining strategy, rows-per-program …). Call sites ask
+:func:`resolve_config` for the config to trace with:
+
+- ``FLAGS_kernel_autotune=off`` (default): the resolve is a plain dict probe
+  returning the declared defaults — no autotuner, no tuning-DB I/O, no
+  verifier, nothing imported beyond this module. Byte-identical to the
+  pre-registry call sites (the inert-layer contract, tier-1 tripwired).
+- ``ondemand``: winners previously persisted in the on-disk tuning DB
+  (``ops/kernels/db.py``) are used when present; a miss falls back to the
+  defaults. Never searches.
+- ``search``: a DB miss triggers a real measured-timing search over the
+  config space (``ops/kernels/autotune.py``) and persists the verified
+  winner.
+
+Resolution happens at TRACE time (shapes are static), so the per-call cost
+with autotune off is one dict lookup — not a per-step runtime cost.
+
+This registry is about *kernel configs*; it is unrelated to
+``ops/registry.py`` (the functional op-surface registry).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+from ...framework import flags
+
+__all__ = ["KernelSpec", "register_kernel", "get_kernel", "kernel_names",
+           "resolve_config"]
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSpec:
+    """One registered kernel.
+
+    ``runner(key)`` returns ``make(config) -> step`` where ``step()`` runs
+    the kernel once on synthetic inputs shaped like ``key`` and returns its
+    output — the autotuner's measurement/verification harness. ``valid``
+    filters configs that cannot trace for ``key`` (e.g. rows-per-program not
+    dividing the batch). Both are only touched in ``ondemand``/``search``.
+    """
+
+    name: str
+    defaults: Mapping[str, Any]
+    space: Mapping[str, Tuple[Any, ...]]
+    runner: Optional[Callable[[tuple], Callable[[dict], Callable[[], Any]]]] = None
+    valid: Optional[Callable[[dict, tuple], bool]] = None
+
+
+_REGISTRY: Dict[str, KernelSpec] = {}
+
+
+def register_kernel(name: str, *, defaults: Mapping[str, Any],
+                    space: Mapping[str, Tuple[Any, ...]],
+                    runner=None, valid=None) -> KernelSpec:
+    """Register (or re-register — last wins, so tests can stub) a kernel."""
+    spec = KernelSpec(name=name, defaults=dict(defaults),
+                      space={k: tuple(v) for k, v in space.items()},
+                      runner=runner, valid=valid)
+    _REGISTRY[name] = spec
+    return spec
+
+
+def get_kernel(name: str) -> KernelSpec:
+    return _REGISTRY[name]
+
+
+def kernel_names():
+    return sorted(_REGISTRY)
+
+
+def resolve_config(name: str, key: tuple = ()) -> Dict[str, Any]:
+    """The one config-resolution door every registered call site goes
+    through. ``key`` is the kernel's shape bucket (see each kernel's
+    ``*_key`` helper) — the DB key is (kernel, key, dtype-in-key, platform,
+    jax version), mirroring the executable cache's keying."""
+    spec = _REGISTRY[name]
+    mode = flags.flag("FLAGS_kernel_autotune", "off")
+    if mode not in ("ondemand", "search"):
+        # inert layer: a dict probe, nothing else (tier-1 tripwire)
+        return dict(spec.defaults)
+    from . import autotune
+
+    return autotune.resolve(spec, tuple(key), mode)

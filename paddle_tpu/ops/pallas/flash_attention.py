@@ -1234,8 +1234,14 @@ def _pick_block(limit, t):
     return 128  # no aligned divisor: 128 block + zero-padding
 
 
-def flash_attention_array(q, k, v, causal=False, block_q=512, block_k=512, interpret=None):
-    """Pure-array flash attention. q,k,v: (B, T, H, D) → (B, T, H, D)."""
+def flash_attention_array(q, k, v, causal=False, block_q=None, block_k=None, interpret=None):
+    """Pure-array flash attention. q,k,v: (B, T, H, D) → (B, T, H, D).
+
+    ``block_q``/``block_k`` default to the kernel registry's resolved config
+    (``ops/kernels``: the pinned 512/512 defaults with autotune off, a tuned
+    winner otherwise); explicit values bypass the registry. Either way the
+    requested blocks flow through ``_pick_block``'s divisibility degrade
+    exactly as before the registry existed."""
     if not _HAS_PALLAS:
         raise RuntimeError("pallas unavailable")
     if interpret is None:
@@ -1249,6 +1255,14 @@ def flash_attention_array(q, k, v, causal=False, block_q=512, block_k=512, inter
         v = v.astype(q.dtype)
     b, t, h, d = q.shape
     t_kv = k.shape[1]
+    if block_q is None or block_k is None:
+        from ..kernels import flash_attention_key, resolve_config
+
+        cfg = resolve_config(
+            "flash_attention",
+            flash_attention_key(b, h, t, t_kv, d, q.dtype, causal))
+        block_q = int(cfg["block_q"]) if block_q is None else block_q
+        block_k = int(cfg["block_k"]) if block_k is None else block_k
     block_q = _pick_block(min(block_q, t), t)
     block_k = _pick_block(min(block_k, t_kv), t_kv)
 

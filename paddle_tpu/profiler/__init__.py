@@ -152,6 +152,19 @@ def counters() -> Dict[str, int]:
     ``stability_coordinated_trips`` / ``stability_barrier_timeouts`` (the
     sentinel's cross-rank VerdictBarrier adoptions and degraded rounds).
 
+    Kernel autotuning (ops/kernels/, FLAGS_kernel_autotune):
+    ``kernel_tune_hits`` / ``kernel_tune_misses`` (registry config
+    resolutions served by the tuning DB vs falling back / searching),
+    ``kernel_tune_searches`` (measured-timing searches run),
+    ``kernel_tune_candidates`` (candidate configs timed),
+    ``kernel_tune_verify_fails`` (candidates rejected by the
+    against-default output check), ``kernel_tune_candidate_errors``
+    (candidates that failed to compile/run), ``kernel_tune_budget_stops``
+    (searches cut short by FLAGS_kernel_tune_budget_s), and
+    ``kernel_tune_db_rejects`` (torn/corrupt DB entries rejected and
+    deleted). All zero while autotuning is off — resolution is then a
+    dict probe that touches none of this machinery.
+
     Telemetry: ``flight_dumps`` (flight-recorder post-mortems written by
     this process).
 

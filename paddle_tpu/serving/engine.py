@@ -516,10 +516,18 @@ class Engine:
         self._dtype = params["wte"].dtype
         self._compute_params = params
         if cfg.int8:
-            from .int8 import dequantize_tree, quantize_params
+            from .int8 import attach_int8_head, dequantize_tree, \
+                quantize_params
 
             self._compute_params = quantize_params(params)
-            self._dequant = lambda p, _d=self._dtype: dequantize_tree(p, _d)
+            if flags.flag("FLAGS_serve_int8_kernel", False):
+                # keep the head's int8 bytes visible to the compiled step so
+                # the decode head runs the weight-only int8_matmul kernel
+                self._dequant = lambda p, _d=self._dtype: attach_int8_head(
+                    dequantize_tree(p, _d), p)
+            else:
+                self._dequant = lambda p, _d=self._dtype: dequantize_tree(
+                    p, _d)
         else:
             self._dequant = None
         self._n_layers = len(params["layers"])
@@ -2105,8 +2113,14 @@ class Engine:
                 return fn
             else:
                 bb, mb = bucket
-                raw = G.build_paged_decode(
-                    self._arch, bb, self.config.block_size, mb)
+                # opt-in Pallas paged-attention decode (bit-identical to the
+                # gather builder; spec-decode above keeps the gather path)
+                if flags.flag("FLAGS_serve_paged_kernel", False):
+                    raw = G.build_paged_decode_kernel(
+                        self._arch, bb, self.config.block_size, mb)
+                else:
+                    raw = G.build_paged_decode(
+                        self._arch, bb, self.config.block_size, mb)
                 donate = (1, 2)
             if self._dequant is not None:
                 dq, inner = self._dequant, raw

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["quantize_params", "dequantize_tree"]
+__all__ = ["quantize_params", "dequantize_tree", "attach_int8_head"]
 
 _TAG = "__int8__"
 
@@ -57,3 +57,21 @@ def dequantize_tree(tree, dtype):
         return node
 
     return walk(tree)
+
+
+def attach_int8_head(dense, tagged):
+    """Graft the still-quantized LM-head weight onto a dequantized tree as
+    ``dense["head_q"] = {"q": int8, "scale": f32[]}`` so the decode head can
+    run the weight-only ``ops/kernels/int8_matmul`` kernel on the int8 bytes
+    (1/4 the HBM traffic of the dequantized matrix) instead of the dense
+    matmul over the dequant. The dense head entry is left in place — GPT's
+    ``wte`` doubles as the embedding table, and XLA dead-code-eliminates the
+    unused dequant when the kernel path consumes ``head_q``. ``tagged`` is
+    the pre-dequant tree from :func:`quantize_params`; a tree whose head was
+    never quantized passes through unchanged."""
+    key = "head_w" if isinstance(tagged, dict) and "head_w" in tagged else "wte"
+    leaf = tagged.get(key) if isinstance(tagged, dict) else None
+    if isinstance(leaf, dict) and _TAG in leaf:
+        dense = dict(dense)
+        dense["head_q"] = {"q": leaf[_TAG], "scale": leaf["scale"]}
+    return dense

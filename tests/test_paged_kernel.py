@@ -1,0 +1,202 @@
+"""Paged-attention decode kernel + int8 head kernel — serving bit-identity.
+
+The ISSUE-18 acceptance surface for the two new serving kernels:
+
+- ``ops/kernels/paged_attention`` reads K/V straight from PagePool blocks
+  through the block table (no gather-then-dense-attend) and must be
+  **bit-identical** to the existing gather path — at the kernel level
+  against the same ``_grouped_attention`` math, at the builder level
+  (``build_paged_decode_kernel`` vs ``build_paged_decode``, GPT and
+  Llama/GQA), and engine end-to-end behind ``FLAGS_serve_paged_kernel``
+  (prefix cache on and off). CPU runs the kernel in Pallas interpret mode.
+- ``ops/kernels/int8_matmul`` (weight-only int8 head matmul behind
+  ``FLAGS_serve_int8_kernel``) must match the dequantize-then-matmul it
+  replaces bitwise, and the engine's int8 path must produce identical
+  tokens with the kernel on or off.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.models.generation as G
+from paddle_tpu.framework import flags
+from paddle_tpu.ops import kernels as K
+from paddle_tpu.serving import Engine
+from serving_util import ENGINE_KW, make_prompts, tiny_gpt
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+
+def _ref_paged(q, kpool, vpool, tables, pos):
+    """The existing serving read: gather context via the block table, then
+    dense grouped attention over live positions."""
+    B, H, D = q.shape
+    NB, BS, KV, _ = kpool.shape
+    T_pad = tables.shape[1] * BS
+    kc = kpool[tables].reshape(B, T_pad, KV, D)
+    vc = vpool[tables].reshape(B, T_pad, KV, D)
+    live = jnp.arange(T_pad)[None, :] <= pos[:, None]
+    o = G._grouped_attention(q[:, None], kc, vc,
+                             live[:, None, None, None, :], H // KV)
+    return o.reshape(B, H * D)
+
+
+def _disjoint_tables(rng, B, MB, NB):
+    """Per-row disjoint block ids, as PagePool guarantees (duplicate ids
+    would make the fresh-KV scatter order compilation-dependent)."""
+    perm = rng.permutation(np.arange(1, NB))[: B * MB]
+    return jnp.asarray(perm.reshape(B, MB).astype(np.int32))
+
+
+class TestPagedKernelBitIdentity:
+    @pytest.mark.parametrize("heads", [(4, 4), (8, 2)],
+                             ids=["mha", "gqa_rep4"])
+    def test_kernel_matches_gather_reference(self, heads):
+        H, KV = heads
+        B, D, BS, MB, NB = 4, 16, 8, 4, 64
+        rng = np.random.RandomState(1)
+        kpool = jnp.asarray(rng.randn(NB, BS, KV, D), jnp.float32)
+        vpool = jnp.asarray(rng.randn(NB, BS, KV, D), jnp.float32)
+        tables = jnp.asarray(rng.randint(1, NB, size=(B, MB)), jnp.int32)
+        pos = jnp.asarray([3, 8, 17, 31], jnp.int32)
+        q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+        ref = np.asarray(_ref_paged(q, kpool, vpool, tables, pos))
+        for score_mode in ("live", "full"):
+            for rows in (1, 2, 4):
+                out = K.paged_attention_rows(
+                    q, kpool, vpool, tables, pos,
+                    config={"rows_per_program": rows,
+                            "score_mode": score_mode})
+                assert np.array_equal(np.asarray(out), ref), \
+                    (score_mode, rows)
+
+    @pytest.mark.parametrize("which", ["gpt", "llama_gqa"])
+    def test_builder_bitwise_vs_gather_builder(self, which):
+        if which == "gpt":
+            _, arch, params, _ = G.gpt_decode_state(tiny_gpt(seed=0))
+            vocab = 211
+        else:
+            from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+            paddle.seed(0)
+            m = LlamaForCausalLM(llama_tiny(num_kv_heads=2))
+            m.eval()
+            _, arch, params, _ = G.llama_decode_state(m)
+            vocab = m.model.config.vocab_size
+        B, BS, MB, NB = 4, 8, 4, 64
+        L, KV, D = len(params["layers"]), arch["kv_heads"], arch["head_dim"]
+        rng = np.random.RandomState(1)
+        kpool = jnp.asarray(rng.randn(L, NB, BS, KV, D), jnp.float32)
+        vpool = jnp.asarray(rng.randn(L, NB, BS, KV, D), jnp.float32)
+        tables = _disjoint_tables(rng, B, MB, NB)
+        pos = jnp.asarray([3, 8, 17, 30], jnp.int32)
+        toks = jnp.asarray(rng.randint(0, vocab, (B,)), jnp.int32)
+        temps = jnp.asarray([0.0, 0.7, 0.0, 1.1], jnp.float32)
+        key = jax.random.PRNGKey(7)
+
+        ref = jax.jit(G.build_paged_decode(arch, B, BS, MB))
+        ker = jax.jit(G.build_paged_decode_kernel(arch, B, BS, MB))
+        r = ref(params, kpool, vpool, tables, pos, toks, temps, key)
+        k = ker(params, kpool, vpool, tables, pos, toks, temps, key)
+        for a, b, name in zip(r, k, ("kpool", "vpool", "next_tokens")):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), name
+
+
+def _run_engine(prompt_seed=3, n=4, max_new=8, **fl):
+    """Token outputs of a fresh tiny-GPT engine under flag overrides."""
+    old = {k: flags._FLAGS.get(k) for k in fl}
+    flags._FLAGS.update(fl)
+    try:
+        with Engine(tiny_gpt(seed=0), **ENGINE_KW) as eng:
+            prompts = make_prompts(n, np.random.RandomState(prompt_seed))
+            handles = [eng.submit(p, max_new_tokens=max_new, temperature=0.0)
+                       for p in prompts]
+            return [h.result(timeout=300) for h in handles]
+    finally:
+        for k, v in old.items():
+            if v is None:
+                flags._FLAGS.pop(k, None)
+            else:
+                flags._FLAGS[k] = v
+
+
+class TestEnginePagedKernel:
+    @pytest.mark.parametrize("prefix_cache", [False, True],
+                             ids=["plain", "prefix_cache"])
+    def test_engine_tokens_identical_with_kernel(self, prefix_cache):
+        base = _run_engine(FLAGS_serve_paged_kernel=False,
+                           FLAGS_serve_prefix_cache=prefix_cache)
+        kern = _run_engine(FLAGS_serve_paged_kernel=True,
+                           FLAGS_serve_prefix_cache=prefix_cache)
+        assert base == kern
+
+    def test_engine_actually_builds_kernel_step(self, monkeypatch):
+        """The flag must really swap the decode builder (not silently keep
+        the gather path)."""
+        called = {"n": 0}
+        real = G.build_paged_decode_kernel
+
+        def spy(*a, **k):
+            called["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(G, "build_paged_decode_kernel", spy)
+        out = _run_engine(FLAGS_serve_paged_kernel=True)
+        assert called["n"] >= 1
+        assert out == _run_engine(FLAGS_serve_paged_kernel=False)
+
+
+class TestInt8Kernel:
+    def test_int8_matmul_bitwise_vs_dequant_matmul(self):
+        rng = np.random.RandomState(2)
+        w = rng.randn(64, 32).astype(np.float32)
+        scale = jnp.asarray(np.abs(w).max(), jnp.float32)
+        qw = jnp.asarray(
+            np.clip(np.round(w / (np.asarray(scale) / 127.0)), -127, 127),
+            jnp.int8)
+        wd = (qw.astype(jnp.float32) * (scale / 127.0)).astype(jnp.float32)
+        x = jnp.asarray(rng.randn(3, 32), jnp.float32)
+        out_t = K.int8_matmul(x, qw, scale, transpose_w=True,
+                              config={"block_n": 512})
+        assert np.array_equal(np.asarray(out_t), np.asarray(x @ wd.T))
+        out_n = K.int8_matmul(x, qw.T, scale, transpose_w=False,
+                              config={"block_n": 512})
+        assert np.array_equal(np.asarray(out_n), np.asarray(x @ wd.T))
+
+    def test_attach_int8_head_grafts_quantized_head(self):
+        from paddle_tpu.serving.int8 import (
+            attach_int8_head, dequantize_tree, quantize_params,
+        )
+
+        _, _, params, _ = G.gpt_decode_state(tiny_gpt(seed=0))
+        tagged = quantize_params(params)
+        dense = dequantize_tree(tagged, jnp.float32)
+        grafted = attach_int8_head(dense, tagged)
+        assert grafted["head_q"]["q"].dtype == jnp.int8
+        assert "head_q" not in dense  # original tree untouched
+        # un-quantized tree passes through unchanged
+        assert attach_int8_head(params, params) is params
+
+    def test_engine_int8_tokens_identical_with_kernel(self, monkeypatch):
+        import paddle_tpu.ops.kernels as KM
+
+        calls = {"n": 0}
+        real = KM.int8_matmul
+
+        def spy(*a, **k):
+            calls["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(KM, "int8_matmul", spy)
+        base = _run_engine(FLAGS_serve_int8=True,
+                           FLAGS_serve_int8_kernel=False)
+        assert calls["n"] == 0  # kernel off: head stays on the dense matmul
+        kern = _run_engine(FLAGS_serve_int8=True,
+                           FLAGS_serve_int8_kernel=True)
+        assert calls["n"] >= 1  # kernel on: the head traced through it
+        assert base == kern
+        both = _run_engine(FLAGS_serve_int8=True,
+                           FLAGS_serve_int8_kernel=True,
+                           FLAGS_serve_paged_kernel=True)
+        assert base == both
