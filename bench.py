@@ -1425,8 +1425,140 @@ def bench_serving(paddle, jax, np, on_tpu):
                                                max_new)
     line["paged_kernel"] = _bench_serving_paged_kernel(
         np, model, ekw, prompts, max_new)
+    line["mesh"] = _bench_serving_mesh(
+        np, model, ekw, prompts, max_new, on_tpu)
+    line["chunked_prefill"] = _bench_serving_chunked_prefill(
+        np, model, cfg.vocab_size, ekw, max_new, on_tpu)
     print("SERVE_PERF " + json.dumps(line))
     return line
+
+
+def _bench_serving_mesh(np, model, ekw, prompts, max_new, on_tpu):
+    """Tensor-parallel serving round (ISSUE-19): the same stream set at
+    tp=1 vs tp=2 (and tp=4 when the box has the devices and the model the
+    heads), reporting per-arm generated tokens/sec, the per-decode-step
+    tensor-parallel collective bytes at fp32 vs blockwise-int8
+    (EQuARX-style wire shrink), and whether the sharded arms stayed
+    bit-identical (the concat-partitioned contract). On a real multi-chip
+    backend the tp arms must hold >= 0.8x linear scaling; CPU "devices"
+    are virtual slices of one socket, so there the scaling ratio is
+    reported but not asserted."""
+    import jax
+
+    import paddle_tpu.models.generation as G
+    from paddle_tpu.serving import Engine
+
+    ndev = jax.device_count()
+    if ndev < 2:
+        return {"skipped": f"{ndev} visible device(s), tp needs >= 2"}
+    arch_key, _, params, _ = G.gpt_decode_state(model)
+    heads = arch_key[1]
+    tps = [1, 2] + [4] * (ndev >= 4 and heads % 4 == 0)
+    sub = prompts[: min(16, len(prompts))]
+    arms, outs = {}, {}
+    for tp in tps:
+        kw = dict(ekw, tp=tp) if tp > 1 else dict(ekw)
+        with Engine(model, **kw) as eng:
+            warm = [eng.submit(p, max_new_tokens=max_new) for p in sub]
+            [h.result(timeout=600) for h in warm]
+            t0 = time.monotonic()
+            hs = [eng.submit(p, max_new_tokens=max_new) for p in sub]
+            res = [h.result(timeout=600) for h in hs]
+            wall = time.monotonic() - t0
+        gen = sum(len(o) - len(p) for o, p in zip(res, sub))
+        arms[tp] = round(gen / max(wall, 1e-9), 1)
+        outs[tp] = res
+    fp32_b, int8_b = G.tp_collective_bytes(arch_key, params, ekw["max_batch"], 2)
+    scaling = {str(tp): round(arms[tp] / max(tp * arms[1], 1e-9), 3)
+               for tp in tps if tp > 1}
+    if on_tpu:
+        for tp, ratio in scaling.items():
+            assert ratio >= 0.8, \
+                f"tp={tp} scaling {ratio} below the 0.8x-linear floor"
+    return {
+        "devices": ndev,
+        "tokens_per_sec": {str(tp): arms[tp] for tp in tps},
+        "linear_scaling": scaling,
+        "scaling_asserted": bool(on_tpu),
+        "identical_tokens": all(outs[tp] == outs[1] for tp in tps[1:]),
+        "collective_bytes_per_step_fp32": fp32_b,
+        "collective_bytes_per_step_int8": int8_b,
+        "int8_wire_shrink": round(fp32_b / max(int8_b, 1), 3),
+    }
+
+
+def _bench_serving_chunked_prefill(np, model, vocab, ekw, max_new, on_tpu):
+    """Chunked-prefill A/B (ISSUE-19): short streams decode while long
+    prompts are admitted mid-flight; the victims' decode-stall p99 (the
+    worst inter-token gap — a monolithic prefill freezes every live stream
+    for the whole pass) must come down when the same admits run one
+    FLAGS_serve_prefill_chunk-sized chunk per scheduler step."""
+    import threading
+
+    from paddle_tpu.serving import Engine
+
+    rng = np.random.RandomState(5)
+    n_vic, long_len = (8, 768) if on_tpu else (4, 96)
+    chunk = ekw["block_size"] * 2
+    victims = [rng.randint(0, vocab, (6,)).tolist() for _ in range(n_vic)]
+    longs = [rng.randint(0, vocab, (long_len,)).tolist() for _ in range(2)]
+
+    # victims need enough decode steps to still be live while the longs
+    # prefill (the whole point of the stall probe) even when the outer
+    # bench runs a tiny max_new on the CPU tier
+    vic_new = max(max_new, 12)
+
+    def arm(chunked):
+        kw = dict(ekw, prefill_chunk=chunk) if chunked else dict(ekw)
+        gaps = []
+        with Engine(model, **kw) as eng:
+            warm = [eng.submit(p, max_new_tokens=max_new)
+                    for p in victims + longs]
+            [h.result(timeout=600) for h in warm]
+            hs = [eng.submit(v, max_new_tokens=vic_new, temperature=0.0,
+                             stream=True)
+                  for v in victims]
+            rows = [[] for _ in hs]
+
+            def consume(h, out):
+                last = time.monotonic()
+                for _tok in h:
+                    now = time.monotonic()
+                    out.append(now - last)
+                    last = now
+
+            threads = [threading.Thread(target=consume, args=(h, rows[i]))
+                       for i, h in enumerate(hs)]
+            [t.start() for t in threads]
+            deadline = time.monotonic() + 60
+            while eng.stats()["decode_steps"] < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.002)
+            lh = [eng.submit(p, max_new_tokens=2) for p in longs]
+            [t.join() for t in threads]
+            [h.result(timeout=600) for h in lh]
+        # drop each victim's first gap (TTFT, includes its own prefill) —
+        # the stall metric is the DECODE inter-token gap
+        for r in rows:
+            gaps.extend(r[1:])
+        gaps.sort()
+        return {
+            "decode_stall_p99_s": round(
+                gaps[min(len(gaps) - 1, int(len(gaps) * 0.99))], 4),
+            "decode_stall_max_s": round(gaps[-1], 4),
+        }
+
+    mono = arm(False)
+    chunked = arm(True)
+    return {
+        "victims": n_vic,
+        "long_prompt_tokens": long_len,
+        "chunk_tokens": chunk,
+        "monolithic": mono,
+        "chunked": chunked,
+        "stall_p99_reduced": chunked["decode_stall_p99_s"]
+        < mono["decode_stall_p99_s"],
+    }
 
 
 def _bench_serving_paged_kernel(np, model, ekw, prompts, max_new):

@@ -163,6 +163,19 @@ class CostModel:
             progs = nn // min(bn, nn)
             vmem = (min(bn, nn) * k_dim + m * k_dim) * 4
             spill = 4.0 if vmem > 8 * 1024 * 1024 else 1.0
+        elif name == "tp_collective":
+            # per-decode-step tensor-parallel all_gather term (serving
+            # PR 19): key = (wire_bytes, tp). A ring gather moves
+            # (tp-1)/tp of the payload per hop over the slowest link;
+            # count the whole payload once (upper bound, ordering-safe)
+            # plus one launch overhead per collective boundary — the
+            # engine uses this as the shed-ETA floor while its measured
+            # decode EMA is still cold.
+            wire_bytes, tp = key
+            ici_bw = 1e11 if platform == "tpu" else 5e9
+            boundaries = max(int(tp) - 1, 1)
+            return (float(wire_bytes) / ici_bw) * 1e3 \
+                + boundaries * overhead_ms
         else:
             return 0.0
         ms = (flops / peak_flops + bytes_ / peak_bw) * 1e3 * spill

@@ -142,6 +142,24 @@ _FLAGS: Dict[str, object] = {
     # tests/test_serving_snapshot.py); Engine.handoff() is an explicit API
     # and needs no flag. Supervisor snapshot= overrides per instance.
     "FLAGS_serve_snapshot": False,
+    # Multi-chip serving (PR 19). FLAGS_serve_tp shards attention heads,
+    # FFN columns, the LM head, and the KV PagePool over a tp-sized mesh
+    # axis (0/1 = single-chip, the exact prior code path). Every tensor-
+    # parallel boundary is a concat-style all_gather of column-partitioned
+    # outputs (never a psum of partials), so greedy decode stays
+    # bit-identical to the single-chip engine. FLAGS_serve_prefill_chunk
+    # splits prompt prefill into chunks of that many tokens (must be a
+    # multiple of the KV block size; 0 = monolithic prefill) interleaved
+    # one chunk per scheduler step with the live decode batch, so a long
+    # prompt no longer stalls every in-flight stream for a full prefill.
+    # FLAGS_serve_tp_int8 quantizes the per-step tensor-parallel
+    # all_gather payloads to blockwise int8 (EQuARX-style, lossy — greedy
+    # tokens may differ; off by default). All three default OFF and their
+    # code paths are never reached unconfigured (inert tripwire in
+    # tests/test_serving_tp.py).
+    "FLAGS_serve_tp": 0,
+    "FLAGS_serve_prefill_chunk": 0,
+    "FLAGS_serve_tp_int8": False,
     # Training stability sentinel (fault/sentinel.py): statistical anomaly
     # detection over per-step signals (loss, global grad norm, update/param
     # ratio, non-finite rate) with a skip -> rollback -> halt policy ladder,

@@ -978,6 +978,507 @@ def build_paged_spec_decode(arch, B, k, block_size, max_blocks):
     return step
 
 
+# ---------------------------------------------------------------------------
+# Tensor-parallel serving programs (mesh-native engine)
+# ---------------------------------------------------------------------------
+# The serving engine shards attention heads, the FFN columns, the LM head,
+# and the paged KV pool over a "tp" mesh axis via shard_map. The sharding is
+# CONCAT-partitioned, never sum-partitioned: every weight matrix that is
+# split is split by OUTPUT columns (heads / FFN features / vocab rows), each
+# device computes its column slice of the activation, and the tp boundary is
+# an all_gather that concatenates the slices back in order. A column slice
+# of a matmul output is the same per-element dot products the single-chip
+# program computes, and the post-gather matmuls (attention proj / FFN down /
+# argmax) run replicated on identical inputs — so greedy decode is
+# bit-identical to the single-chip engine, which a psum of partial products
+# could never guarantee. Embeddings and norms stay replicated (tiny); GPT's
+# tied head gets its OWN vocab-row-sharded copy of wte while the replicated
+# wte keeps serving the embedding lookup. The host-side block tables,
+# PagePool bookkeeping, and scheduler state stay replicated — only the
+# device KV arrays are sharded (on the kv-heads axis), so pool conservation,
+# prefix-cache chaining, snapshot/adopt, and preemption are unchanged.
+
+_INT8_TAG = "__int8__"  # serving/int8.quantize_params leaf encoding
+
+
+def _tp_dims(arch_key):
+    """(kind, H, KV, D, L, theta, eps) from a decode-state ``arch_key``."""
+    if arch_key[0] == "gpt":
+        _, H, D, L = arch_key
+        return "gpt", H, H, D, L, None, None
+    _, H, KV, D, L, theta, eps = arch_key
+    return "llama", H, KV, D, L, theta, eps
+
+
+def _tp_leaf(leaf, fn):
+    """Apply ``fn`` to a weight leaf, looking through the int8 tagged-dict
+    encoding. The scale is per-TENSOR, so slice-then-dequantize is bitwise
+    dequantize-then-slice — an int8 engine shards the int8 bytes and
+    dequantizes inside the shard_map body."""
+    if isinstance(leaf, dict) and _INT8_TAG in leaf:
+        return {_INT8_TAG: fn(leaf[_INT8_TAG]), "scale": leaf["scale"]}
+    return fn(leaf)
+
+
+def _tp_shape(leaf):
+    if isinstance(leaf, dict) and _INT8_TAG in leaf:
+        return leaf[_INT8_TAG].shape
+    return leaf.shape
+
+
+def tp_validate(arch_key, params, tp):
+    """Shard-divisibility requirements for a tp degree; returns
+    ``(ffn_width, vocab)``. Heads, kv heads, and the FFN width must divide
+    evenly (the vocab is zero-padded to a tp multiple instead — padded
+    logits are sliced off after the gather, so they can never win argmax)."""
+    kind, H, KV, D, L, _, _ = _tp_dims(arch_key)
+    ffn = _tp_shape(params["layers"][0]["up_w"])[1]
+    vocab = (_tp_shape(params["wte"])[0] if kind == "gpt"
+             else _tp_shape(params["head_w"])[1])
+    for name, n in (("attention heads", H), ("kv heads", KV),
+                    ("ffn width", ffn)):
+        if n % tp:
+            raise ValueError(
+                f"serving: tp={tp} must divide the model's {name} ({n})")
+    return ffn, vocab
+
+
+def tp_pack_params(arch_key, params, tp):
+    """Host-side split of a decode weight tree (float or int8-tagged) into
+    ``({"rep": replicated_tree, "shard": stacked_tree}, vocab)``.
+
+    ``shard`` holds, per weight, the tp per-device column slices stacked on
+    a NEW leading axis (tp, ...) — placed with ``P("tp")`` the leading axis
+    shards one standard-layout slice per device, and the shard_map body
+    squeezes it with ``leaf[0]``. GPT's fused qkv is sliced through its
+    (H·D, 3, H, D) view so each device owns whole (q, k, v) triples for its
+    heads; the head weight is vocab-sliced after zero-padding the vocab to a
+    tp multiple."""
+    kind, H, KV, D, L, _, _ = _tp_dims(arch_key)
+    ffn, vocab = tp_validate(arch_key, params, tp)
+    Hl, KVl, Fl = H // tp, KV // tp, ffn // tp
+    HD = H * D
+    vp = -(-vocab // tp) * tp
+    Vl = vp // tp
+
+    def pad_vocab(a, axis):
+        if vp == vocab:
+            return a
+        width = [(0, 0)] * a.ndim
+        width[axis] = (0, vp - vocab)
+        return jnp.pad(a, width)
+
+    def dev_tree(d):
+        if kind == "gpt":
+            head = _tp_leaf(params["wte"], lambda a: pad_vocab(a, 0)[
+                d * Vl:(d + 1) * Vl])
+            layers = [{
+                "qkv_w": _tp_leaf(w["qkv_w"], lambda a: a.reshape(
+                    HD, 3, H, D)[:, :, d * Hl:(d + 1) * Hl].reshape(
+                        HD, 3 * Hl * D)),
+                "qkv_b": w["qkv_b"].reshape(3, H, D)[
+                    :, d * Hl:(d + 1) * Hl].reshape(-1),
+                "up_w": _tp_leaf(w["up_w"], lambda a: a[:, d * Fl:(d + 1) * Fl]),
+                "up_b": w["up_b"][d * Fl:(d + 1) * Fl],
+            } for w in params["layers"]]
+        else:
+            head = _tp_leaf(params["head_w"], lambda a: pad_vocab(a, 1)[
+                :, d * Vl:(d + 1) * Vl])
+            layers = [{
+                "q_w": _tp_leaf(w["q_w"], lambda a: a.reshape(HD, H, D)[
+                    :, d * Hl:(d + 1) * Hl].reshape(HD, Hl * D)),
+                "k_w": _tp_leaf(w["k_w"], lambda a: a.reshape(HD, KV, D)[
+                    :, d * KVl:(d + 1) * KVl].reshape(HD, KVl * D)),
+                "v_w": _tp_leaf(w["v_w"], lambda a: a.reshape(HD, KV, D)[
+                    :, d * KVl:(d + 1) * KVl].reshape(HD, KVl * D)),
+                "gate_w": _tp_leaf(w["gate_w"],
+                                   lambda a: a[:, d * Fl:(d + 1) * Fl]),
+                "up_w": _tp_leaf(w["up_w"], lambda a: a[:, d * Fl:(d + 1) * Fl]),
+            } for w in params["layers"]]
+        return {"head_w": head, "layers": layers}
+
+    if kind == "gpt":
+        rep = {k: params[k] for k in ("wte", "wpe", "lnf_w", "lnf_b")}
+        rep_keys = ("ln1_w", "ln1_b", "proj_w", "proj_b", "ln2_w", "ln2_b",
+                    "down_w", "down_b")
+    else:
+        rep = {k: params[k] for k in ("wte", "lnf_w")}
+        rep_keys = ("ln1_w", "o_w", "ln2_w", "down_w")
+    rep["layers"] = [{k: w[k] for k in rep_keys} for w in params["layers"]]
+    devs = [dev_tree(d) for d in range(tp)]
+    shard = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *devs)
+    return {"rep": rep, "shard": shard}, vocab
+
+
+def tp_collective_bytes(arch_key, params, B, tp):
+    """Per-decode-step tensor-parallel all_gather wire bytes as
+    ``(fp32_bytes, int8_bytes)`` — the payload crossing the tp boundary per
+    step (attention output + FFN intermediate per layer, plus the padded
+    logits), counted over all devices. The int8 figure includes the f32
+    blockwise scales (one per 128 elements, per-device payload padded to a
+    block multiple) — the wire cost the EQuARX-style quantized-collective
+    flag actually pays."""
+    kind, H, KV, D, L, _, _ = _tp_dims(arch_key)
+    ffn, vocab = tp_validate(arch_key, params, tp)
+    vp = -(-vocab // tp) * tp
+    sizes = [B * H * D, B * ffn] * L + [B * vp]
+
+    def wire(n, int8):
+        if not int8:
+            return n * 4
+        blocks = -(-(n // tp) // 128)
+        return tp * blocks * (128 * 1 + 4)
+
+    return (sum(wire(n, False) for n in sizes),
+            sum(wire(n, True) for n in sizes))
+
+
+def _tp_gather(y, quantized):
+    """Concat-partitioned tp boundary: all_gather the column shards along
+    the last axis. Bitwise exact — every element of the gathered tensor is
+    the very dot product the single-chip program computes, just computed on
+    one device and copied. With ``quantized`` (FLAGS_serve_tp_int8) the
+    payload crosses the wire as blockwise int8 + f32 scales (EQuARX-style,
+    ~3.9x fewer bytes, LOSSY — greedy tokens may differ)."""
+    if not quantized:
+        return lax.all_gather(y, "tp", axis=y.ndim - 1, tiled=True)
+    from ..distributed.collective import (blockwise_dequantize,
+                                          blockwise_quantize)
+
+    flat = y.reshape(-1)
+    m = flat.shape[0]
+    pad = -m % 128
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    q, s = blockwise_quantize(flat)
+    qg = lax.all_gather(q, "tp")  # (tp, blocks, 128) int8
+    sg = lax.all_gather(s, "tp")
+    parts = [blockwise_dequantize(qg[i], sg[i], y.dtype)[:m].reshape(y.shape)
+             for i in range(qg.shape[0])]
+    return jnp.concatenate(parts, axis=y.ndim - 1)
+
+
+def _tp_arch(arch_key, tp, vocab, int8_wire):
+    """Per-device layer drivers for the tp programs: local column-sharded
+    projections + local grouped attention, an all_gather at the attention
+    and FFN boundaries, replicated second matmuls. Mirrors the single-chip
+    arch plugs op for op so the concat of the shards is bitwise the
+    single-chip activation."""
+    kind, H, KV, D, L, theta, eps = _tp_dims(arch_key)
+    Hl, KVl = H // tp, KV // tp
+    rep = H // KV  # GQA group width is tp-invariant (both axes sharded)
+
+    def embed_prompt(rw, ids, T0):
+        if kind == "gpt":
+            return rw["wte"][ids] + rw["wpe"][jnp.arange(T0)][None]
+        return rw["wte"][ids]
+
+    def embed_rows(rw, toks, pos):
+        if kind == "gpt":
+            return rw["wte"][toks][:, None] + rw["wpe"][pos][:, None]
+        return rw["wte"][toks][:, None]
+
+    def embed_tail(rw, ids, starts):
+        if kind == "gpt":
+            T = ids.shape[1]
+            pos = starts[:, None] + jnp.arange(T)[None, :]
+            return rw["wte"][ids] + rw["wpe"][pos]
+        return rw["wte"][ids]
+
+    def qkv(rwl, swl, x, posm):
+        # local projections: x (B,T,H·D) replicated -> q (B,T,Hl,D),
+        # k/v (B,T,KVl,D) — column slices of the single-chip projections
+        B, T = x.shape[0], x.shape[1]
+        if kind == "gpt":
+            h = _ln(x, rwl["ln1_w"], rwl["ln1_b"])
+            qkv_ = (h @ swl["qkv_w"] + swl["qkv_b"]).reshape(B, T, 3, Hl, D)
+            return qkv_[:, :, 0], qkv_[:, :, 1], qkv_[:, :, 2]
+        h = _rms(x, rwl["ln1_w"], eps)
+        q = (h @ swl["q_w"]).reshape(B, T, Hl, D)
+        k = (h @ swl["k_w"]).reshape(B, T, KVl, D)
+        v = (h @ swl["v_w"]).reshape(B, T, KVl, D)
+        return _rope_grid(q, posm, theta), _rope_grid(k, posm, theta), v
+
+    def post_attn(rwl, swl, x, o):
+        # o (B,T,Hl·D) local attention read -> gathered full heads, then
+        # the replicated proj/down matmuls (identical inputs everywhere)
+        o = _tp_gather(o, int8_wire)
+        if kind == "gpt":
+            x = x + (o @ rwl["proj_w"] + rwl["proj_b"])
+            h2 = _ln(x, rwl["ln2_w"], rwl["ln2_b"])
+            ff = _tp_gather(jax.nn.gelu(h2 @ swl["up_w"] + swl["up_b"],
+                                        approximate=True), int8_wire)
+            return x + (ff @ rwl["down_w"] + rwl["down_b"])
+        x = x + o @ rwl["o_w"]
+        h2 = _rms(x, rwl["ln2_w"], eps)
+        ff = _tp_gather(jax.nn.silu(h2 @ swl["gate_w"]) * (h2 @ swl["up_w"]),
+                        int8_wire)
+        return x + ff @ rwl["down_w"]
+
+    def layer_rows(rwl, swl, x, k_ctx, v_ctx, live, pos):
+        # decode mirror of block_rows against the gathered local-shard ctx
+        B = x.shape[0]
+        rows_i = jnp.arange(B)
+        q, k, v = qkv(rwl, swl, x, pos[:, None])
+        k_new, v_new = k[:, 0], v[:, 0]
+        kc = k_ctx.at[rows_i, pos].set(k_new)
+        vc = v_ctx.at[rows_i, pos].set(v_new)
+        o = _grouped_attention(q, kc, vc, live[:, None, None, None, :], rep)
+        return post_attn(rwl, swl, x, o), k_new, v_new
+
+    def layer_tail(rwl, swl, x, k_ctx, v_ctx, live, starts):
+        # multi-token mirror of block_tail (tail prefill / chunked prefill)
+        B, T = x.shape[0], x.shape[1]
+        rows_i = jnp.arange(B)[:, None]
+        posm = starts[:, None] + jnp.arange(T)[None, :]
+        q, k, v = qkv(rwl, swl, x, posm)
+        kc = k_ctx.at[rows_i, posm].set(k)
+        vc = v_ctx.at[rows_i, posm].set(v)
+        o = _grouped_attention(q, kc, vc, live[:, None, None], rep)
+        return post_attn(rwl, swl, x, o), k, v
+
+    def layer_full(rwl, swl, x):
+        # dense causal prefill mirror of arch["block"]'s prefill branch
+        B, T = x.shape[0], x.shape[1]
+        posm = jnp.broadcast_to(jnp.arange(T), (B, T))
+        q, k, v = qkv(rwl, swl, x, posm)
+        live = jnp.tril(jnp.ones((T, T), bool))[None, None, None]
+        o = _grouped_attention(q, k, v, live, rep)
+        return post_attn(rwl, swl, x, o), k, v
+
+    def head_rows(rw, sw, x, idx):
+        if kind == "gpt":
+            h = _ln(x, rw["lnf_w"], rw["lnf_b"])
+        else:
+            h = _rms(x, rw["lnf_w"], eps)
+        rows = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
+        loc = rows @ (sw["head_w"].T if kind == "gpt" else sw["head_w"])
+        # padded vocab columns are sliced off post-gather (static slice)
+        return _tp_gather(loc, int8_wire)[:, :vocab]
+
+    return {"embed_prompt": embed_prompt, "embed_rows": embed_rows,
+            "embed_tail": embed_tail, "qkv": qkv, "post_attn": post_attn,
+            "layer_rows": layer_rows, "layer_tail": layer_tail,
+            "layer_full": layer_full, "head_rows": head_rows,
+            "n_layers": L, "kv_local": KVl, "head_dim": D}
+
+
+def _tp_pool_spec():
+    from jax.sharding import PartitionSpec as P
+
+    return P(None, None, None, "tp", None)
+
+
+def tp_pool_sharding(mesh):
+    """NamedSharding splitting a (L, NB, BS, KV, D) pool on its kv-heads
+    axis — each device owns heads/tp of EVERY block, so the replicated
+    host-side block tables index every shard identically."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, _tp_pool_spec())
+
+
+def tp_param_shardings(mesh):
+    """(replicated, stacked-shard) NamedShardings for tp_pack_params trees."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P()), NamedSharding(mesh, P("tp"))
+
+
+def _tp_shard_map(body, mesh, in_specs, out_specs):
+    from ..core import compat
+
+    return compat.shard_map(body, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs,
+                            **compat.shard_map_check_kwargs(False))
+
+
+def _tp_local(shard_tree, dtype):
+    """Squeeze the stacked (1, ...) local view and dequantize int8 leaves
+    INSIDE the shard_map body (per-tensor scales make it bitwise equal to
+    dequantize-then-slice)."""
+    from ..serving.int8 import dequantize_tree
+
+    sq = jax.tree_util.tree_map(lambda a: a[0], shard_tree)
+    return dequantize_tree(sq, dtype)
+
+
+def build_tp_paged_decode(arch_key, B, block_size, max_blocks, mesh, vocab,
+                          dtype, use_kernel=False, int8_wire=False):
+    """Tensor-parallel ``build_paged_decode`` (or ``_kernel`` with
+    ``use_kernel``): same step signature with the packed param tree from
+    :func:`tp_pack_params` in place of ``params``, kpool/vpool tp-sharded on
+    the kv-heads axis, tables/pos/toks/temps/key replicated. Greedy tokens
+    are bit-identical to the single-chip builders (see the section comment);
+    the paged-attention kernel path works unchanged on the local shard —
+    its block DMA reads local (NB, BS, KVl, D) pools and H/KV keeps the
+    same GQA ratio."""
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape["tp"]
+    arch = _tp_arch(arch_key, tp, vocab, int8_wire)
+    L, KVl, D = arch["n_layers"], arch["kv_local"], arch["head_dim"]
+    T_pad = block_size * max_blocks
+    pool_s = _tp_pool_spec()
+
+    def body(rep_tree, shard_tree, kpool, vpool, tables, pos, toks, temps,
+             key):
+        from ..serving.int8 import dequantize_tree
+
+        rw = dequantize_tree(rep_tree, dtype)
+        sw = _tp_local(shard_tree, dtype)
+        x = arch["embed_rows"](rw, toks, pos)
+        bids = jnp.take_along_axis(tables, (pos // block_size)[:, None],
+                                   axis=1)[:, 0]
+        offs = pos % block_size
+        if use_kernel:
+            from ..ops.kernels import paged_attention_rows
+
+            for li in range(L):
+                rwl = rw["layers"][li]
+                swl = sw["layers"][li]
+                q, k, v = arch["qkv"](rwl, swl, x, pos[:, None])
+                kpool = kpool.at[li, bids, offs].set(k[:, 0])
+                vpool = vpool.at[li, bids, offs].set(v[:, 0])
+                o = paged_attention_rows(q[:, 0], kpool[li], vpool[li],
+                                         tables, pos)
+                x = arch["post_attn"](rwl, swl, x, o[:, None])
+        else:
+            live = jnp.arange(T_pad)[None, :] <= pos[:, None]
+            # gathers hoisted above the scatter chain (see build_paged_decode)
+            ctx = [(kpool[li][tables].reshape(B, T_pad, KVl, D),
+                    vpool[li][tables].reshape(B, T_pad, KVl, D))
+                   for li in range(L)]
+            for li in range(L):
+                x, k_new, v_new = arch["layer_rows"](
+                    rw["layers"][li], sw["layers"][li], x,
+                    ctx[li][0], ctx[li][1], live, pos)
+                kpool = kpool.at[li, bids, offs].set(k_new)
+                vpool = vpool.at[li, bids, offs].set(v_new)
+        logits = arch["head_rows"](rw, sw, x, jnp.zeros((B,), jnp.int32))
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = (logits / jnp.maximum(temps, 1e-6)[:, None]).astype(
+            jnp.float32)
+        sampled = jax.random.categorical(key, scaled, axis=-1).astype(
+            jnp.int32)
+        nxt = jnp.where(temps > 0, sampled, greedy)
+        return kpool, vpool, nxt
+
+    wrapped = _tp_shard_map(
+        body, mesh,
+        (P(), P("tp"), pool_s, pool_s, P(), P(), P(), P(), P()),
+        (pool_s, pool_s, P()))
+
+    def step(packed, kpool, vpool, tables, pos, toks, temps, key):
+        return wrapped(packed["rep"], packed["shard"], kpool, vpool, tables,
+                       pos, toks, temps, key)
+
+    return step
+
+
+def build_tp_paged_prefill(arch_key, B, T_bucket, block_size, max_blocks,
+                           mesh, vocab, dtype, int8_wire=False):
+    """Tensor-parallel ``build_paged_prefill``: same signature with the
+    packed param tree; each device scatters its local (B, nb, BS, KVl, D)
+    K/V shard into its pool shard at the REPLICATED block table."""
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape["tp"]
+    if T_bucket % block_size:
+        raise ValueError(
+            f"prefill bucket {T_bucket} must be a multiple of block_size "
+            f"{block_size}")
+    nb = T_bucket // block_size
+    if nb > max_blocks:
+        raise ValueError("prefill bucket exceeds max sequence blocks")
+    arch = _tp_arch(arch_key, tp, vocab, int8_wire)
+    L, KVl, D = arch["n_layers"], arch["kv_local"], arch["head_dim"]
+    pool_s = _tp_pool_spec()
+
+    def body(rep_tree, shard_tree, ids, lens, tables, kpool, vpool):
+        from ..serving.int8 import dequantize_tree
+
+        rw = dequantize_tree(rep_tree, dtype)
+        sw = _tp_local(shard_tree, dtype)
+        x = arch["embed_prompt"](rw, ids, T_bucket)
+        tb = tables[:, :nb]
+        for li in range(L):
+            x, k, v = arch["layer_full"](rw["layers"][li], sw["layers"][li],
+                                         x)
+            kpool = kpool.at[li, tb].set(
+                k.reshape(B, nb, block_size, KVl, D))
+            vpool = vpool.at[li, tb].set(
+                v.reshape(B, nb, block_size, KVl, D))
+        logits = arch["head_rows"](rw, sw, x, lens - 1)
+        return kpool, vpool, logits
+
+    wrapped = _tp_shard_map(
+        body, mesh, (P(), P("tp"), P(), P(), P(), pool_s, pool_s),
+        (pool_s, pool_s, P()))
+
+    def prefill(packed, ids, lens, tables, kpool, vpool):
+        return wrapped(packed["rep"], packed["shard"], ids, lens, tables,
+                       kpool, vpool)
+
+    return prefill
+
+
+def build_tp_paged_tail_prefill(arch_key, B, T_bucket, block_size, max_blocks,
+                                mesh, vocab, dtype, int8_wire=False):
+    """Tensor-parallel ``build_paged_tail_prefill`` — also the chunked-
+    prefill workhorse: a chunk at a block-aligned offset IS a tail feed at
+    absolute positions, reading the earlier chunks' K/V through the block
+    table and writing its own through the same paged scatter."""
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape["tp"]
+    if T_bucket % block_size:
+        raise ValueError(
+            f"tail-prefill bucket {T_bucket} must be a multiple of "
+            f"block_size {block_size}")
+    nb = T_bucket // block_size
+    T_pad = block_size * max_blocks
+    arch = _tp_arch(arch_key, tp, vocab, int8_wire)
+    L, KVl, D = arch["n_layers"], arch["kv_local"], arch["head_dim"]
+    pool_s = _tp_pool_spec()
+
+    def body(rep_tree, shard_tree, ids, starts, lens, tables, kpool, vpool):
+        from ..serving.int8 import dequantize_tree
+
+        rw = dequantize_tree(rep_tree, dtype)
+        sw = _tp_local(shard_tree, dtype)
+        x = arch["embed_tail"](rw, ids, starts)
+        posm = starts[:, None] + jnp.arange(T_bucket)[None, :]
+        live = jnp.arange(T_pad)[None, None, :] <= posm[:, :, None]
+        cols = (starts // block_size)[:, None] + jnp.arange(nb)[None, :]
+        bids = jnp.take_along_axis(
+            tables, jnp.minimum(cols, max_blocks - 1), axis=1)
+        bids = jnp.where(cols < max_blocks, bids, 0)  # 0 = trash block
+        ctx = [(kpool[li][tables].reshape(B, T_pad, KVl, D),
+                vpool[li][tables].reshape(B, T_pad, KVl, D))
+               for li in range(L)]
+        for li in range(L):
+            x, k_new, v_new = arch["layer_tail"](
+                rw["layers"][li], sw["layers"][li], x,
+                ctx[li][0], ctx[li][1], live, starts)
+            kpool = kpool.at[li, bids].set(
+                k_new.reshape(B, nb, block_size, KVl, D))
+            vpool = vpool.at[li, bids].set(
+                v_new.reshape(B, nb, block_size, KVl, D))
+        logits = arch["head_rows"](rw, sw, x, lens - 1)
+        return kpool, vpool, logits
+
+    wrapped = _tp_shard_map(
+        body, mesh, (P(), P("tp"), P(), P(), P(), P(), pool_s, pool_s),
+        (pool_s, pool_s, P()))
+
+    def prefill(packed, ids, starts, lens, tables, kpool, vpool):
+        return wrapped(packed["rep"], packed["shard"], ids, starts, lens,
+                       tables, kpool, vpool)
+
+    return prefill
+
+
 def build_window_draft(arch, B, W, k):
     """Model drafter: k greedy proposals per row from a SMALL same-family
     model over a dense sliding window of the newest ``W`` tokens.

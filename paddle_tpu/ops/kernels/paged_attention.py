@@ -33,6 +33,14 @@ bf16-on-TPU note: the surrounding model runs its score einsum under the
 global ``jax_default_matmul_precision`` while Mosaic uses the MXU's native
 bf16×bf16→f32; the bit-identity pin is the f32 CPU tier, TPU bf16 parity is
 numeric (same contract as the flash kernel).
+
+Tensor-parallel note: under ``FLAGS_serve_tp`` the engine calls this kernel
+INSIDE the per-device shard_map body with the local KV-head shard — q is
+``(B, KV_local*rep, D)``, the pools are the chip's ``kv_heads/tp`` slice,
+and the block tables are the replicated host truth. Attention is
+independent per KV group, so the kernel needs no axis awareness: the local
+call is exactly a smaller-KV instance of the same contract, and the tp
+boundary (one all_gather of the per-head outputs) lives in the caller.
 """
 from __future__ import annotations
 

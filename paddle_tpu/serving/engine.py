@@ -141,7 +141,8 @@ class EngineConfig:
                  max_seq_len=None, prefill_batch=None, int8=None,
                  decode_buckets=None, seed=0, max_queue=None, shed=None,
                  prefix_cache=None, spec_k=None, drafter=None,
-                 draft_window=None):
+                 draft_window=None, tp=None, prefill_chunk=None,
+                 tp_int8=None):
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.max_batch = max_batch
@@ -159,6 +160,11 @@ class EngineConfig:
         self.spec_k = spec_k
         self.drafter = drafter
         self.draft_window = draft_window
+        # mesh-native serving (PR 19): tensor-parallel degree, chunked
+        # prefill grain, and EQuARX-style int8 tp collectives
+        self.tp = tp
+        self.prefill_chunk = prefill_chunk
+        self.tp_int8 = tp_int8
 
     def resolve(self, model_max_positions: int) -> "EngineConfig":
         def pick(v, name):
@@ -189,6 +195,25 @@ class EngineConfig:
         self.draft_window = int(self.draft_window
                                 if self.draft_window is not None
                                 else flags.flag("FLAGS_serve_draft_window", 64))
+        self.tp = pick(self.tp, "FLAGS_serve_tp")
+        self.prefill_chunk = pick(self.prefill_chunk,
+                                  "FLAGS_serve_prefill_chunk")
+        if self.tp_int8 is None:
+            self.tp_int8 = bool(flags.flag("FLAGS_serve_tp_int8", False))
+        if self.tp < 0:
+            raise ValueError("serving: tp must be >= 0 (0/1 = single-chip)")
+        if self.prefill_chunk < 0:
+            raise ValueError("serving: prefill_chunk must be >= 0 "
+                             "(0 = monolithic prefill)")
+        if self.prefill_chunk and self.block_size \
+                and self.prefill_chunk % self.block_size:
+            raise ValueError(
+                "serving: prefill_chunk must be a multiple of block_size "
+                "(chunk boundaries write K/V through the paged scatter)")
+        if self.tp >= 2 and (self.spec_k or 0) > 0:
+            raise ValueError(
+                "serving: speculative decoding is not yet supported under "
+                "tensor-parallel serving (set spec_k=0 or tp<=1)")
         if self.spec_k < 0:
             raise ValueError("serving: spec_k must be >= 0")
         if self.spec_k and self.draft_window < 2:
@@ -398,9 +423,12 @@ class _Seq:
     write position is ``pos = len(tokens) - 1``, which is also the next
     decode step's fed token. ``cached_blocks`` counts the leading blocks
     admission matched from the prefix cache (shared, already filled — the
-    prefill pass runs only the tail)."""
+    prefill pass runs only the tail). ``chunk_pos`` is the chunked-prefill
+    cursor: prompt tokens below it have K/V in cache (0 outside the chunked
+    path, where the whole prompt lands in one prefill pass)."""
 
-    __slots__ = ("req", "tokens", "blocks", "prompt_len", "cached_blocks")
+    __slots__ = ("req", "tokens", "blocks", "prompt_len", "cached_blocks",
+                 "chunk_pos")
 
     def __init__(self, req: _Request, tokens: List[int]):
         self.req = req
@@ -408,6 +436,7 @@ class _Seq:
         self.blocks: List[int] = []
         self.prompt_len = len(req.prompt)
         self.cached_blocks = 0
+        self.chunk_pos = 0
 
     @property
     def pos(self) -> int:
@@ -513,8 +542,26 @@ class Engine:
         cfg = copy.copy(config or EngineConfig(**overrides)).resolve(max_pos)
         self.config = cfg
         self._arch = arch
+        self._arch_key = arch_key
         self._dtype = params["wte"].dtype
         self._compute_params = params
+        # tensor-parallel serving (PR 19): tp >= 2 shards heads / FFN
+        # columns / the LM head / the KV pool over a "tp" mesh axis. 0/1
+        # leaves every code path below byte-for-byte the single-chip one.
+        self._tp = int(cfg.tp) if int(cfg.tp) >= 2 else 0
+        self._tp_mesh = None
+        self._tp_vocab = None
+        if self._tp:
+            ndev = len(jax.devices())
+            if self._tp > ndev:
+                raise ValueError(
+                    f"serving: tp={self._tp} exceeds the {ndev} visible "
+                    "devices")
+            from jax.sharding import Mesh
+
+            self._tp_mesh = Mesh(
+                np.array(jax.devices()[:self._tp]), ("tp",))
+            G.tp_validate(arch_key, params, self._tp)
         if cfg.int8:
             from .int8 import attach_int8_head, dequantize_tree, \
                 quantize_params
@@ -530,6 +577,21 @@ class Engine:
                     p, _d)
         else:
             self._dequant = None
+        if self._tp:
+            # pack the (possibly int8-tagged) tree into per-device column
+            # slices stacked on a leading tp axis; dequantization moves
+            # INSIDE the shard_map body (per-tensor scales make
+            # slice-then-dequantize bitwise dequantize-then-slice), so the
+            # engine-side wrapper is retired. FLAGS_serve_int8_kernel is a
+            # single-chip head fusion and is ignored under tp.
+            packed, self._tp_vocab = G.tp_pack_params(
+                arch_key, self._compute_params, self._tp)
+            rep_s, shard_s = G.tp_param_shardings(self._tp_mesh)
+            self._compute_params = {
+                "rep": jax.device_put(packed["rep"], rep_s),
+                "shard": jax.device_put(packed["shard"], shard_s),
+            }
+            self._dequant = None
         self._n_layers = len(params["layers"])
         kv, hd = arch["kv_heads"], arch["head_dim"]
         self._spec_k = int(cfg.spec_k)
@@ -538,8 +600,18 @@ class Engine:
         self._max_blocks = -(-(cfg.max_seq_len + self._spec_k)
                              // cfg.block_size)
         shape = (self._n_layers, cfg.num_blocks, cfg.block_size, kv, hd)
-        self._kpool = jnp.zeros(shape, self._dtype)
-        self._vpool = jnp.zeros(shape, self._dtype)
+        if self._tp:
+            # KV pool sharded on the kv-heads axis: every device owns
+            # heads/tp of EVERY block, so the replicated host-side block
+            # tables / PagePool bookkeeping index all shards identically
+            pool_s = G.tp_pool_sharding(self._tp_mesh)
+            self._kpool = jax.device_put(jnp.zeros(shape, self._dtype),
+                                         pool_s)
+            self._vpool = jax.device_put(jnp.zeros(shape, self._dtype),
+                                         pool_s)
+        else:
+            self._kpool = jnp.zeros(shape, self._dtype)
+            self._vpool = jnp.zeros(shape, self._dtype)
         self._pool = PagePool(cfg.num_blocks)
         self._prefill_buckets = self._make_prefill_buckets()
         self._prefix = (_PrefixCache(self._pool, cfg.block_size)
@@ -575,6 +647,25 @@ class Engine:
         self._running: List[_Seq] = []
         self._resume: List[_Seq] = []  # preempted, awaiting re-prefill
         self._admitting: List[_Seq] = []  # popped off the queue, mid-prefill
+        # chunked prefill (PR 19): seqs whose prompt is being prefilled one
+        # FLAGS_serve_prefill_chunk-token chunk per scheduler step, so a
+        # long admit no longer stalls the live decode batch for a whole
+        # prefill. 0 = monolithic prefill, the exact prior path.
+        self._chunk = int(cfg.prefill_chunk) if int(cfg.prefill_chunk) > 0 \
+            else 0
+        self._prefilling: List[_Seq] = []
+        # analytic floor for the shed ETA while the decode EMA is cold: the
+        # cost model's estimate of the per-step tp collective term (0.0 on
+        # a single chip or when the backend is unknown to the model)
+        self._step_floor_s = 0.0
+        if self._tp:
+            from ..cost_model import CostModel
+
+            fp32_b, int8_b = G.tp_collective_bytes(
+                arch_key, params, cfg.max_batch, self._tp)
+            wire = int8_b if cfg.tp_int8 else fp32_b
+            self._step_floor_s = CostModel().kernel_estimate(
+                "tp_collective", (int(wire), int(self._tp)), {}) / 1e3
         self._key = jax.random.PRNGKey(cfg.seed)
         self._rng = np.random.default_rng(cfg.seed)
         self._step_i = 0
@@ -864,7 +955,8 @@ class Engine:
         with self._cv:
             waiting = list(self._waiting)
             self._waiting.clear()
-        seqs = list(self._running) + list(self._resume) + list(self._admitting)
+        seqs = list(self._running) + list(self._resume) \
+            + list(self._admitting) + list(self._prefilling)
         for req in waiting + [s.req for s in seqs]:
             try:
                 self._finish_request(req, error=ServeError(str(err)))
@@ -877,9 +969,15 @@ class Engine:
         the KV pool arrays and block tables are only meaningful against the
         same paged-cache geometry."""
         cfg = self.config
+        # tp degree + KV shard layout close a silent-corruption hole: a
+        # tp=2 pool array is numerically identical gathered, but adopting
+        # it onto a different mesh shape would re-shard live KV under the
+        # replicated block tables — refuse instead (structured error,
+        # re-prefill fallback)
         return (self._n_layers, int(cfg.num_blocks), int(cfg.block_size),
                 int(self._arch["kv_heads"]), int(self._arch["head_dim"]),
-                str(self._dtype))
+                str(self._dtype), int(self._tp),
+                "kv-shard/tp" if self._tp else "replicated")
 
     def snapshot(self) -> dict:
         """O(blocks) consistent capture of the live serving state: pool
@@ -904,7 +1002,8 @@ class Engine:
             seqs, seen = [], set()
             for phase, group in (("running", self._running),
                                  ("resume", self._resume),
-                                 ("admitting", self._admitting)):
+                                 ("admitting", self._admitting),
+                                 ("prefilling", self._prefilling)):
                 for s in group:
                     if s.req.id in seen:
                         continue  # landed mid-prefill: the _running view wins
@@ -1283,14 +1382,16 @@ class Engine:
                 self._stop = True
                 self._quiesced.set()
                 return "handoff"
-            idle = not (self._waiting or self._running or self._resume)
+            idle = not (self._waiting or self._running or self._resume
+                        or self._prefilling)
             if self._draining and idle:
                 self._stop = True  # drain complete: fall through to stop
             if not self._stop and idle:
                 self._cv.wait(timeout=0.5)
             if self._stop:
                 return True
-            has_work = bool(self._waiting or self._running or self._resume)
+            has_work = bool(self._waiting or self._running or self._resume
+                            or self._prefilling)
         if has_work:
             self._step()
         if self._watchdog is not None:
@@ -1331,9 +1432,13 @@ class Engine:
             # _waiting nor _running until prefill lands); cleared only on
             # success — _shutdown sweeps it after a crash
             self._admitting = self._admit()
+            if self._admitting and self._chunk:
+                self._admitting = self._chunk_divert(self._admitting)
             if self._admitting:
                 self._prefill(self._admitting)
             self._admitting = []
+            if self._prefilling:
+                self._chunk_step()
             if self._running:
                 if self._spec_k:
                     self._decode_spec()
@@ -1413,6 +1518,19 @@ class Engine:
             if not seq.req.done.is_set():
                 self._resume.append(seq)
         self._admitting = []
+        # ditto a mid-chunked-prefill OOM: partial chunk K/V is abandoned
+        # with the blocks — resume re-prefills the whole prompt
+        for seq in self._prefilling:
+            try:
+                if seq.blocks:
+                    self._pool.free(seq.blocks)
+            except Exception:  # lint: ok(oom-handler) — pool itself may be what broke; the sweep must reach every seq
+                pass
+            seq.blocks = []
+            seq.chunk_pos = 0
+            if not seq.req.done.is_set():
+                self._resume.append(seq)
+        self._prefilling = []
         if self._prefix is not None and len(self._prefix):
             # cached-prefix KV is the most expendable resident state under
             # exhaustion — drop half before parking shrinks live headroom
@@ -1459,7 +1577,11 @@ class Engine:
         EMA) is shed at admission — rejecting early is cheaper than paying a
         prefill it will abandon."""
         now = time.monotonic()
-        ema = self._ema_step_s
+        # while the measured decode EMA is cold, the cost model's analytic
+        # per-step tp-collective term is the feasibility floor — a sharded
+        # engine's first deadline'd admits would otherwise assume 0-cost
+        # steps and accept doomed work
+        ema = max(self._ema_step_s, self._step_floor_s)
         shed = []
         with self._cv:
             for req in [r for r in self._waiting if r.deadline is not None]:
@@ -1493,6 +1615,18 @@ class Engine:
             self._finish_request(seq.req, error=DeadlineExceeded(
                 f"request {seq.req.id} deadline expired while preempted "
                 f"({seq.generated}/{seq.req.max_new_tokens} generated)",
+                request_id=seq.req.id))
+        for seq in [s for s in self._prefilling
+                    if s.req.deadline is not None
+                    and now >= s.req.deadline]:
+            self._prefilling.remove(seq)
+            if seq.blocks:
+                self._pool.free(seq.blocks)
+                seq.blocks = []
+            counter_inc("serve_deadline_expired")
+            self._finish_request(seq.req, error=DeadlineExceeded(
+                f"request {seq.req.id} deadline expired mid-chunked-prefill "
+                f"({seq.chunk_pos}/{len(seq.tokens)} tokens cached)",
                 request_id=seq.req.id))
 
     # -- admission ----------------------------------------------------------
@@ -1719,6 +1853,78 @@ class Engine:
             self._append_token(s, self._sample_host(rows[r], s.req))
             if not s.req.done.is_set():
                 self._running.append(s)
+
+    # -- chunked prefill (PR 19) ---------------------------------------------
+    def _chunk_divert(self, seqs: List[_Seq]) -> List[_Seq]:
+        """Route admitted sequences whose un-cached prompt tail exceeds one
+        chunk into the incremental queue; the rest (short prompts gain
+        nothing from chunking) keep the monolithic path. The diverted
+        sequence already owns ALL its prompt blocks — only the K/V writes
+        are spread over steps."""
+        keep: List[_Seq] = []
+        bs = self.config.block_size
+        for s in seqs:
+            if len(s.tokens) - s.cached_blocks * bs > self._chunk:
+                s.chunk_pos = s.cached_blocks * bs
+                self._prefilling.append(s)
+            else:
+                keep.append(s)
+        return keep
+
+    def _chunk_step(self):
+        """Advance chunked prefill by AT MOST one program call (<=
+        prefill_batch rows x one chunk of tokens each), then fall through
+        to the live decode batch — the scheduler-step interleave that keeps
+        a 4k-token admit from freezing every in-flight stream. Each chunk
+        is a tail feed at absolute positions: chunk boundaries are
+        block-aligned (prefill_chunk % block_size == 0, cached prefixes are
+        whole blocks), earlier chunks' K/V is read back through the block
+        table, and the write goes through the existing paged scatter — so
+        prefix-cached tails compose and the result is bit-identical to
+        monolithic prefill. Intermediate chunk logits are discarded; the
+        final chunk lands the sequence exactly like a monolithic pass."""
+        jnp = self._jnp
+        bw = self.config.prefill_batch
+        batch = self._prefilling[:bw]
+        feeds = [min(self._chunk, len(s.tokens) - s.chunk_pos)
+                 for s in batch]
+        t_bucket = self._bucket_for(max(feeds))
+        with span("prefill", bucket_t=t_bucket, bucket_b=bw,
+                  rows=len(batch), chunked=True):
+            self._beat = time.monotonic()
+            n_fns = len(self._fns)
+            fn = self._get_fn("prefill_tail", bw, t_bucket)
+            self._compiling = len(self._fns) != n_fns
+            ids = np.zeros((bw, t_bucket), np.int32)
+            starts = np.zeros((bw,), np.int32)
+            lens = np.ones((bw,), np.int32)
+            tables = np.full((bw, self._max_blocks), TRASH_BLOCK, np.int32)
+            for r, s in enumerate(batch):
+                ids[r, :feeds[r]] = s.tokens[s.chunk_pos:s.chunk_pos
+                                             + feeds[r]]
+                starts[r] = s.chunk_pos
+                lens[r] = feeds[r]
+                tables[r, :len(s.blocks)] = s.blocks
+            self._kpool, self._vpool, logits = fn(
+                self._compute_params, jnp.asarray(ids),
+                jnp.asarray(starts), jnp.asarray(lens),
+                jnp.asarray(tables), self._kpool, self._vpool,
+            )
+            counter_inc("serve_prefill_chunks")
+            done = [r for r, s in enumerate(batch)
+                    if s.chunk_pos + feeds[r] >= len(s.tokens)]
+            rows = (np.asarray(logits) if done
+                    else None)  # only final chunks need the logits host-side
+            self._beat = time.monotonic()
+            self._compiling = False
+            for r, s in enumerate(batch):
+                s.chunk_pos += feeds[r]
+            if done:
+                finished = [batch[r] for r in done]
+                self._prefilling = [s for s in self._prefilling
+                                    if s not in finished]
+                counter_inc("serve_prefills", len(finished))
+                self._land_prefill(finished, rows[done])
 
     def _sample_host(self, logits_row: np.ndarray, req: _Request) -> int:
         """First generated token (prefill output) is sampled host-side; the
@@ -2037,6 +2243,15 @@ class Engine:
             self._resume.remove(seq)
             self._finish_request(seq.req, error=RequestCancelled(
                 f"request {seq.req.id} cancelled"))
+        # mid-chunked-prefill cancels free their (fully allocated) prompt
+        # blocks immediately — chunks already written are simply abandoned
+        for seq in [s for s in self._prefilling if s.req.cancelled]:
+            self._prefilling.remove(seq)
+            if seq.blocks:
+                self._pool.free(seq.blocks)
+                seq.blocks = []
+            self._finish_request(seq.req, error=RequestCancelled(
+                f"request {seq.req.id} cancelled"))
         # queued-but-unadmitted cancels must not wait for a batch slot: a
         # saturated engine would otherwise sit on them for minutes
         with self._cv:
@@ -2064,7 +2279,8 @@ class Engine:
         # Per-sequence guards: when the crash WAS a pool inconsistency, the
         # same free() would raise again here — one bad sequence must not
         # stop us failing the remaining handles.
-        for seq in list(self._running) + list(self._resume) + list(self._admitting):
+        for seq in list(self._running) + list(self._resume) \
+                + list(self._admitting) + list(self._prefilling):
             try:
                 if seq.blocks:
                     self._pool.free(seq.blocks)
@@ -2076,6 +2292,7 @@ class Engine:
             except Exception:  # lint: ok(oom-handler) — handle-state sweep, nothing dispatches in this try
                 pass
         self._running, self._resume, self._admitting = [], [], []
+        self._prefilling = []
 
     # -- compiled-program cache ----------------------------------------------
     def _get_fn(self, kind: str, *bucket):
@@ -2085,6 +2302,44 @@ class Engine:
         fn = self._fns.get(key)
         if fn is None:
             jax, G = self._jax, self._G
+            if self._tp:
+                # tensor-parallel builders: packed param tree, shard_map
+                # body, dequantization inside the body — no outer dequant
+                # wrapper. Same call signatures, same donation slots.
+                tpkw = dict(mesh=self._tp_mesh, vocab=self._tp_vocab,
+                            dtype=self._dtype,
+                            int8_wire=bool(self.config.tp_int8))
+                if kind == "prefill":
+                    bw, t_bucket = bucket
+                    raw = G.build_tp_paged_prefill(
+                        self._arch_key, bw, t_bucket,
+                        self.config.block_size, self._max_blocks, **tpkw)
+                    donate = (4, 5)
+                elif kind == "prefill_tail":
+                    bw, t_bucket = bucket
+                    raw = G.build_tp_paged_tail_prefill(
+                        self._arch_key, bw, t_bucket,
+                        self.config.block_size, self._max_blocks, **tpkw)
+                    donate = (5, 6)
+                elif kind == "decode":
+                    bb, mb = bucket
+                    raw = G.build_tp_paged_decode(
+                        self._arch_key, bb, self.config.block_size, mb,
+                        use_kernel=bool(
+                            flags.flag("FLAGS_serve_paged_kernel", False)),
+                        **tpkw)
+                    donate = (1, 2)
+                else:  # spec/draft excluded by EngineConfig validation
+                    raise RuntimeError(
+                        f"serving: program kind {kind!r} has no "
+                        "tensor-parallel build")
+                if jax.default_backend() == "cpu":
+                    fn = jax.jit(raw)
+                else:
+                    fn = jax.jit(raw, donate_argnums=donate)
+                self._fns[key] = fn
+                counter_inc("serve_compiles")
+                return fn
             if kind == "prefill":
                 bw, t_bucket = bucket
                 raw = G.build_paged_prefill(
@@ -2146,6 +2401,15 @@ class Engine:
             "queue_depth": depth,
             "step": self._step_i,
             "spec_k": self._spec_k,
+            # mesh + chunked-prefill state (PR 19): post-mortems on a
+            # sharded engine must name the mesh, and a stall diagnosis
+            # needs the chunk backlog at the crash step
+            "tp": self._tp,
+            "prefill_chunk": self._chunk,
+            "chunk_queue_depth": len(self._prefilling),
+            "pending_chunks": sum(
+                -(-(len(s.tokens) - s.chunk_pos) // max(self._chunk, 1))
+                for s in list(self._prefilling)),
             "prefix_cached_blocks": (self._prefix.blocks
                                      if self._prefix is not None else 0),
             "pages": {"used": self._pool.used_blocks,

@@ -12,6 +12,14 @@ tiny.
 The tagged-dict encoding keeps the tree a plain pytree, so the same bucket
 programs jit over either representation; ``dequantize_tree`` is traced into
 the program, where XLA schedules the dequant next to the consuming matmul.
+
+Tensor-parallel serving composes with this path BECAUSE the scale is
+per-tensor: ``generation.tp_pack_params`` slices the int8 payload
+column-wise per device and carries the single scalar scale to every shard,
+so shard-then-dequant is bitwise the same numbers as dequant-then-shard —
+weight-only int8 under tp keeps the concat-partitioned bit-identity
+contract for free. (Per-channel scales would need slicing too; the tagged
+dict keeps that door open.)
 """
 from __future__ import annotations
 

@@ -39,6 +39,13 @@ a CRC over the canonical encoding, and ``restore`` rebuilds a pool from a
 capture — re-running ``check()`` plus structural validation so a torn or
 tampered snapshot surfaces as a structured :class:`SnapshotError`, never a
 silently-wrong allocator.
+
+Tensor-parallel serving does not change ANY of this: the device-side KV
+arrays are sharded over the mesh on the kv_heads axis (each chip owns
+``kv_heads/tp`` of every block), but a block id names the same slot on
+every shard, so this host-side allocator — free list, refcounts, parked
+set, snapshots, conservation — stays REPLICATED and tp-oblivious. One
+bookkeeping truth drives ``tp`` physical shards.
 """
 from __future__ import annotations
 

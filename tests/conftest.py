@@ -53,6 +53,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow') to hold its "
+        "time budget; redundant grid points and heavy cross-feature "
+        "composes whose core contract is already pinned by a tier-1 test",
+    )
+    config.addinivalue_line(
+        "markers",
         "faults: fault-injection / fault-tolerance tests (CPU-fast, tier-1)",
     )
     config.addinivalue_line(
