@@ -24,6 +24,15 @@ Each rule encodes an invariant a past incident or PR established:
   but never present in ``framework/flags.py`` nor passed to
   ``register_flag``: the typo guard in ``set_flags`` can only reject what
   the registry knows about.
+* ``counter-registry`` — mirror of flag-registry for profiler counters:
+  every counter bumped anywhere (``counter_inc``/``_counter`` literal
+  first args — including conditional-expression branches — and
+  ``step_counters()`` dict keys) must appear in
+  ``profiler.KNOWN_COUNTERS``, every registered name must be bumped
+  somewhere, and every registered name must be documented
+  (double-backticked) in the ``profiler.counters()`` docstring. A counter
+  that dashboards can't discover (or a doc entry for a counter that no
+  longer exists) is silent telemetry rot.
 * ``bare-except`` — a bare ``except:`` (or ``except BaseException`` that
   does not re-raise) in retry/commit paths swallows ``KeyboardInterrupt``/
   ``SystemExit`` and can convert a preemption drain into a hang.
@@ -57,8 +66,18 @@ __all__ = [
 
 RULES = (
     "host-sync", "compat-shim", "atomic-write", "monotonic-deadline",
-    "flag-registry", "bare-except", "oom-handler",
+    "flag-registry", "counter-registry", "bare-except", "oom-handler",
 )
+
+# counter-registry anchors: the registry lives in profiler/__init__.py as
+# KNOWN_COUNTERS, documented in the counters() docstring; bumps route
+# through these callables (literal first args only — the fault/retry.py
+# `_counter(name, n)` pass-through and the distributed engine's
+# `counter_inc(k, v)` loop over step_counters() are dynamic and resolved
+# via their literal sources instead)
+_COUNTER_REGISTRY_FILE = "profiler/__init__.py"
+_COUNTER_FUNCS = ("counter_inc", "_counter")
+_DOC_NAME = re.compile(r"``([A-Za-z0-9_]+)``")
 
 # host-sync applies only to hot-path packages (metric/, hapi/ etc. read
 # results by design); paths are package-relative, '/'-normalized
@@ -208,6 +227,14 @@ class _Linter(_ScopeVisitor):
         self.findings: List[Finding] = []
         self.flag_refs: List[Tuple[int, str, str]] = []  # (line, scope, name)
         self.flag_registered: Set[str] = set()
+        self.counter_refs: List[Tuple[int, str, str]] = []  # (line, scope, name)
+        self.counter_registered: Dict[str, int] = {}  # name -> line
+        self.counter_documented: Set[str] = set()
+        if relpath == _COUNTER_REGISTRY_FILE:
+            for n in ast.walk(tree):
+                if isinstance(n, ast.FunctionDef) and n.name == "counters":
+                    doc = ast.get_docstring(n) or ""
+                    self.counter_documented |= set(_DOC_NAME.findall(doc))
         # per-function: does it call os.replace (or equivalent rename)?
         self._atomic_funcs = self._collect_atomic_functions(tree)
         self._func_stack: List[ast.AST] = []
@@ -349,6 +376,27 @@ class _Linter(_ScopeVisitor):
                         self.flag_registered.add(a.value)
                     else:
                         self.flag_refs.append((node.lineno, self.scope(), a.value))
+
+        # counter-registry: collect counter bump sites. A conditional
+        # expression as the name (`counter_inc("a" if c else "b")`) bumps
+        # every branch, so every branch is a reference.
+        if fname in _COUNTER_FUNCS and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                self.counter_refs.append((node.lineno, self.scope(), a.value))
+            elif isinstance(a, ast.IfExp):
+                # walk only the VALUE positions (body/orelse, nested
+                # conditionals included) — the test expression's string
+                # literals are predicates, not counter names
+                stack = [a.body, a.orelse]
+                while stack:
+                    sub = stack.pop()
+                    if isinstance(sub, ast.IfExp):
+                        stack += [sub.body, sub.orelse]
+                    elif isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str):
+                        self.counter_refs.append(
+                            (node.lineno, self.scope(), sub.value))
         self.generic_visit(node)
 
     def visit_Import(self, node: ast.Import):
@@ -386,6 +434,15 @@ class _Linter(_ScopeVisitor):
             for t in node.targets:
                 if isinstance(t, ast.Name):
                     self._wall_names[-1].add(t.id)
+        # counter-registry: the registry itself (profiler KNOWN_COUNTERS)
+        if (
+            self.relpath == _COUNTER_REGISTRY_FILE
+            and any(isinstance(t, ast.Name) and t.id == "KNOWN_COUNTERS"
+                    for t in node.targets)
+        ):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    self.counter_registered.setdefault(sub.value, sub.lineno)
         self.generic_visit(node)
 
     def visit_Compare(self, node: ast.Compare):
@@ -461,6 +518,15 @@ class _Linter(_ScopeVisitor):
                 if isinstance(k, ast.Constant) and isinstance(k.value, str) \
                         and k.value.startswith("FLAGS_"):
                     self.flag_registered.add(k.value)
+        # counter-registry: a `step_counters()` dict is fed verbatim into
+        # `counter_inc(k, v)` by the distributed engine — its string keys
+        # are counter bumps
+        if self._func_stack and getattr(
+                self._func_stack[-1], "name", "") == "step_counters":
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    self.counter_refs.append(
+                        (k.lineno, self.scope(), k.value))
         self.generic_visit(node)
 
     # track the current top-level statement for expression-local name scans
@@ -472,9 +538,9 @@ class _Linter(_ScopeVisitor):
         return super().visit(node)
 
 
-def lint_source(source: str, relpath: str) -> Tuple[List[Finding], List, Set[str]]:
-    """Lint one file. Returns (findings, flag_refs, flags_registered) — the
-    flag data is resolved cross-file by :func:`lint_package`."""
+def _analyze(source: str, relpath: str) -> Tuple[List[Finding], "_Linter"]:
+    """Run the per-file linter; returns the suppression-filtered findings
+    plus the visitor itself (cross-file flag/counter data rides on it)."""
     tree = ast.parse(source, filename=relpath)
     linter = _Linter(relpath, tree)
     linter.visit(tree)
@@ -483,6 +549,13 @@ def lint_source(source: str, relpath: str) -> Tuple[List[Finding], List, Set[str
         f for f in linter.findings
         if f.rule not in suppressed.get(f.line, ())
     ]
+    return kept, linter
+
+
+def lint_source(source: str, relpath: str) -> Tuple[List[Finding], List, Set[str]]:
+    """Lint one file. Returns (findings, flag_refs, flags_registered) — the
+    flag data is resolved cross-file by :func:`lint_package`."""
+    kept, linter = _analyze(source, relpath)
     refs = [(relpath, ln, scope, name) for ln, scope, name in linter.flag_refs]
     return kept, refs, linter.flag_registered
 
@@ -500,12 +573,15 @@ def lint_package(root: str,
     findings: List[Finding] = []
     all_refs: List[Tuple[str, int, str, str]] = []
     registered: Set[str] = set()
+    counter_refs: List[Tuple[str, int, str, str]] = []
+    counter_registered: Dict[str, int] = {}
+    counter_documented: Set[str] = set()
     for path in iter_py_files(root):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
         with open(path, encoding="utf-8") as f:
             source = f.read()
         try:
-            file_findings, refs, regs = lint_source(source, rel)
+            file_findings, linter = _analyze(source, rel)
         except SyntaxError as e:
             findings.append(Finding(
                 "parse-error", rel, e.lineno or 0, "<module>",
@@ -513,8 +589,13 @@ def lint_package(root: str,
             ))
             continue
         findings.extend(file_findings)
-        all_refs.extend(refs)
-        registered |= regs
+        all_refs.extend(
+            (rel, ln, scope, name) for ln, scope, name in linter.flag_refs)
+        registered |= linter.flag_registered
+        counter_refs.extend(
+            (rel, ln, scope, name) for ln, scope, name in linter.counter_refs)
+        counter_registered.update(linter.counter_registered)
+        counter_documented |= linter.counter_documented
     for rel, ln, scope, name in all_refs:
         if name not in registered:
             findings.append(Finding(
@@ -522,5 +603,32 @@ def lint_package(root: str,
                 f"{name} referenced but never registered in framework/flags.py "
                 "(set_flags typo-guard cannot protect it)",
             ))
+    # counter-registry, three directions: bumped-but-unregistered at the
+    # bump site; registered-but-never-bumped and registered-but-undocumented
+    # at the registry entry. (The checks only engage when the package under
+    # lint actually carries the registry — a synthetic test package without
+    # profiler/__init__.py shouldn't fail on its own counter bumps.)
+    if counter_registered:
+        bumped = {name for _, _, _, name in counter_refs}
+        for rel, ln, scope, name in counter_refs:
+            if name not in counter_registered:
+                findings.append(Finding(
+                    "counter-registry", rel, ln, scope,
+                    f"counter {name!r} bumped here but missing from "
+                    "profiler.KNOWN_COUNTERS (dashboards can't discover it)",
+                ))
+        for name, ln in sorted(counter_registered.items()):
+            if name not in bumped:
+                findings.append(Finding(
+                    "counter-registry", _COUNTER_REGISTRY_FILE, ln, "<module>",
+                    f"counter {name!r} registered in KNOWN_COUNTERS but never "
+                    "bumped anywhere (stale registry entry)",
+                ))
+            if name not in counter_documented:
+                findings.append(Finding(
+                    "counter-registry", _COUNTER_REGISTRY_FILE, ln, "counters",
+                    f"counter {name!r} registered but not documented "
+                    "(``double-backticked``) in the counters() docstring",
+                ))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return _apply_baseline(findings, baseline)

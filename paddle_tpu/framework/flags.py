@@ -160,6 +160,22 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_serve_tp": 0,
     "FLAGS_serve_prefill_chunk": 0,
     "FLAGS_serve_tp_int8": False,
+    # Serving SLO observability (PR 20, serving/observe.py).
+    # FLAGS_serve_trace arms request-scoped tracing + the SLO metric layer:
+    # every submitted request carries a trace id attached to each span it
+    # touches (queue wait, shed, prefix match, prefill chunks, decode steps,
+    # CoW, eviction, relay), completed per-request timelines land in a
+    # bounded ring (FLAGS_serve_trace_ring capacity, chrome-trace/JSONL
+    # exportable), and TTFT / inter-token gap / end-to-end / queue-wait
+    # histograms per priority class flow into export_metrics(). Off
+    # (default): the observe module is never touched — one attribute probe
+    # per step, engine behavior byte-identical (inert tripwire in
+    # tests/test_serving_observe.py). FLAGS_serve_metrics_port > 0 starts
+    # the opt-in stdlib http.server telemetry thread (/metrics, /healthz,
+    # /readyz, /debug/requests); 0 (default) = zero threads.
+    "FLAGS_serve_trace": False,
+    "FLAGS_serve_trace_ring": 256,
+    "FLAGS_serve_metrics_port": 0,
     # Training stability sentinel (fault/sentinel.py): statistical anomaly
     # detection over per-step signals (loss, global grad norm, update/param
     # ratio, non-finite rate) with a skip -> rollback -> halt policy ladder,

@@ -156,6 +156,9 @@ def search(spec, key: tuple):
     deadline = time.monotonic() + budget_s
     make = spec.runner(key)
     counter_inc("kernel_tune_searches")
+    from ...cost_model import CostModel
+
+    cm = CostModel()
     with span("kernel_tune", kernel=spec.name) as sp:
         try:
             ref_out, default_ms = _measure(make, spec.defaults, samples)
@@ -166,6 +169,12 @@ def search(spec, key: tuple):
             sp.set(result="default_failed")
             return dict(spec.defaults), None, None, False
         best_cfg, best_ms = dict(spec.defaults), default_ms
+        # cost-model drift (PR 20): (analytic estimate, measured ms) per
+        # config that actually ran — the model's job here is ORDERING the
+        # visit sequence, so its drift sample is the discordant-pair
+        # fraction between estimated and measured rankings
+        measured = [(cm.kernel_estimate(spec.name, key, dict(spec.defaults)),
+                     default_ms)]
         tried = 0
         for cfg in candidates(spec, key):
             if time.monotonic() >= deadline:
@@ -180,6 +189,7 @@ def search(spec, key: tuple):
                 # disqualifies it
                 counter_inc("kernel_tune_candidate_errors")
                 continue
+            measured.append((cm.kernel_estimate(spec.name, key, cfg), ms))
             if not verify(out, ref_out):
                 counter_inc("kernel_tune_verify_fails")
                 continue
@@ -187,4 +197,26 @@ def search(spec, key: tuple):
                 best_cfg, best_ms = dict(cfg), ms
         sp.set(candidates=tried, default_ms=default_ms, best_ms=best_ms,
                tuned=best_cfg != dict(spec.defaults))
+        if len(measured) >= 2:
+            disc = tot = 0
+            for i in range(len(measured)):
+                for j in range(i + 1, len(measured)):
+                    (ei, mi), (ej, mj) = measured[i], measured[j]
+                    if ei == ej or mi == mj:
+                        continue
+                    tot += 1
+                    if (ei < ej) != (mi < mj):
+                        disc += 1
+            if tot:
+                frac = disc / tot
+                sp.set(cost_drift=round(frac, 6))
+                try:
+                    from ...serving import observe as _observe
+
+                    _observe.drift_value(
+                        "kernel_estimate", frac, pairs=tot,
+                        measured=len(measured))
+                except Exception:
+                    # drift accounting must never take a tuning search down
+                    pass
     return best_cfg, best_ms, default_ms, True

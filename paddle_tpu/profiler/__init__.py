@@ -165,6 +165,63 @@ def counters() -> Dict[str, int]:
     deleted). All zero while autotuning is off — resolution is then a
     dict probe that touches none of this machinery.
 
+    Prefix cache + CoW KV sharing (serving/prefix.py): ``serve_prefix_hits``
+    / ``serve_prefix_misses`` (admissions that found / missed a cached
+    prompt prefix), ``serve_prefix_blocks_shared`` (KV blocks adopted from
+    the cache instead of re-prefilled), ``serve_prefix_evicted`` (cached
+    prefixes dropped by the LRU bound), ``serve_pages_shared`` (blocks
+    holding refcount > 1 at share time), and ``serve_cow_copies``
+    (copy-on-write block duplications when a shared block is written).
+
+    Chunked prefill (FLAGS_serve_prefill_chunk): ``serve_prefill_chunks``
+    (prompt chunks executed through the chunk bucket) and
+    ``serve_tail_prefills`` (final partial chunks landed through the
+    ordinary prefill path).
+
+    Speculative decoding (FLAGS_serve_spec_k): ``serve_draft_proposed``
+    / ``serve_draft_accepted`` (draft tokens proposed vs accepted by the
+    target-model verify — their ratio is the acceptance rate).
+
+    Serving state durability (rounds 17-18): ``serve_snapshots`` /
+    ``serve_snapshot_failed`` / ``serve_snapshot_rejected`` (KV-pool
+    snapshot writes, failures, and stale/corrupt restores rejected),
+    ``serve_pool_restores`` (pools rebuilt from a snapshot),
+    ``serve_adoptions`` (engines adopting a restored pool),
+    ``serve_reattached`` / ``serve_reattached_blocks`` (crash re-attach:
+    requests resumed onto snapshot KV state and the blocks they kept),
+    ``serve_reprefill_tokens`` / ``serve_reprefill_tokens_saved`` (tokens
+    re-prefilled after recovery vs spared by re-attach),
+    ``serve_handoffs`` (zero-downtime engine→engine handoffs), and
+    ``serve_restart_mttr_ms`` (cumulative supervisor detect→ready repair
+    time).
+
+    Serving observability (this round): ``serve_trace_evicted`` (completed
+    request timelines dropped from the bounded trace ring),
+    ``serve_http_requests`` (telemetry endpoint GETs served), and
+    ``serve_http_bind_failed`` (endpoint start-ups that lost the port —
+    telemetry never takes serving down).
+
+    Host embedding offload (incubate/host_embedding.py): ``host_emb_lookups`` /
+    ``host_emb_block_ns`` (gather round-trips and attributed host-wait
+    time), ``host_emb_hot_hits`` / ``host_emb_hot_misses`` (device-resident
+    hot-shard membership), ``host_emb_cache_admitted`` /
+    ``host_emb_cache_evicted`` / ``host_emb_cache_shrinks`` (hot-cache
+    churn), ``host_emb_prefetch_hits`` / ``host_emb_prefetch_drops`` /
+    ``host_emb_prefetch_patched`` (lookahead pipeline), and
+    ``host_emb_push_bytes`` (host-side gradient write-back volume).
+
+    Numeric stability sentinel (stability/): ``stability_observed`` /
+    ``stability_trips`` / ``stability_skips`` / ``stability_halts`` /
+    ``stability_rollbacks`` / ``stability_readbacks`` (steps watched,
+    verdicts tripped, and the skip/halt/rollback reactions plus device
+    readbacks the policy paid for).
+
+    Cluster plumbing: ``ckpt_coordinated_commits`` (multi-host checkpoint
+    barrier commits), ``heartbeat_failures`` (elastic heartbeat misses),
+    ``watchdog_trips`` (collective-watchdog stall detections),
+    ``io_quarantine_skips`` (poisoned input batches skipped), and
+    ``lazy_verify_passes`` (FLAGS_lazy_verify replay cross-checks).
+
     Telemetry: ``flight_dumps`` (flight-recorder post-mortems written by
     this process).
 
@@ -173,6 +230,68 @@ def counters() -> Dict[str, int]:
     chrome-trace metadata; ``bench.py`` folds it into every BENCH JSON line.
     """
     return dict(_counters)
+
+
+# The counter registry: every counter the package bumps, by name. The
+# ``counter-registry`` lint rule (analysis/lint.py) enforces the three-way
+# contract — every ``counter_inc`` literal in the package appears here,
+# every name here is bumped somewhere, and every name here is documented
+# (double-backticked) in the :func:`counters` docstring above. Adding a
+# counter means adding it in all three places; the lint failure names the
+# one you forgot.
+KNOWN_COUNTERS = frozenset({
+    "ckpt_coordinated_commits", "ckpt_resume_fallbacks",
+    "ckpt_save_failures", "ckpt_saves",
+    "dispatch_fastkey_hits",
+    "dp_all_reduces", "dp_buckets", "dp_gather_bytes",
+    "dp_reduce_scatters", "dp_sync_bytes",
+    "flight_dumps",
+    "hbm_admission_checks", "hbm_admission_rejects", "hbm_cache_evicted",
+    "hbm_degraded_steps", "hbm_oom_recoveries", "hbm_oom_trips",
+    "heartbeat_failures",
+    "host_emb_block_ns", "host_emb_cache_admitted",
+    "host_emb_cache_evicted", "host_emb_cache_shrinks",
+    "host_emb_hot_hits", "host_emb_hot_misses", "host_emb_lookups",
+    "host_emb_prefetch_drops", "host_emb_prefetch_hits",
+    "host_emb_prefetch_patched", "host_emb_push_bytes",
+    "io_device_prefetched", "io_quarantine_skips",
+    "kernel_tune_budget_stops", "kernel_tune_candidate_errors",
+    "kernel_tune_candidates", "kernel_tune_db_rejects",
+    "kernel_tune_hits", "kernel_tune_misses", "kernel_tune_searches",
+    "kernel_tune_verify_fails",
+    "lazy_bg_aot_fallbacks", "lazy_bg_compile_failures",
+    "lazy_bg_compiles", "lazy_bg_pickups", "lazy_bg_replays",
+    "lazy_block_ns", "lazy_blocks", "lazy_cache_hits",
+    "lazy_deferred_checks", "lazy_donated_buffers",
+    "lazy_donation_fallbacks", "lazy_flushes", "lazy_verify_passes",
+    "naninf_donation_suppressed", "naninf_trips",
+    "preemption_drains", "retry_attempts",
+    "serve_admitted", "serve_adoptions", "serve_backpressure",
+    "serve_cancelled", "serve_compiles", "serve_cow_copies",
+    "serve_crash_detected", "serve_deadline_expired",
+    "serve_deadline_shed", "serve_decode_steps",
+    "serve_draft_accepted", "serve_draft_proposed",
+    "serve_engine_errors", "serve_failed", "serve_handoffs",
+    "serve_http_bind_failed", "serve_http_requests",
+    "serve_occupancy_live", "serve_occupancy_slots",
+    "serve_pages_allocated", "serve_pages_freed", "serve_pages_parked",
+    "serve_pages_shared", "serve_pages_unparked", "serve_pool_damaged",
+    "serve_pool_restores", "serve_pool_shrunk", "serve_preempted",
+    "serve_prefill_chunks", "serve_prefills",
+    "serve_prefix_blocks_shared", "serve_prefix_evicted",
+    "serve_prefix_hits", "serve_prefix_misses",
+    "serve_reattached", "serve_reattached_blocks", "serve_relayed",
+    "serve_reprefill_tokens", "serve_reprefill_tokens_saved",
+    "serve_requests", "serve_requeued", "serve_restart_mttr_ms",
+    "serve_restarts", "serve_retired", "serve_shed",
+    "serve_snapshot_failed", "serve_snapshot_rejected",
+    "serve_snapshots", "serve_tail_prefills", "serve_tokens",
+    "serve_trace_evicted", "serve_wedge_detected", "serve_wedged_close",
+    "stability_barrier_timeouts", "stability_coordinated_trips",
+    "stability_halts", "stability_observed", "stability_readbacks",
+    "stability_rollbacks", "stability_skips", "stability_trips",
+    "watchdog_trips", "wus_enabled",
+})
 
 
 def reset_counters():

@@ -121,12 +121,15 @@ def metrics_snapshot() -> dict:
         flags = dict(_FLAGS)
     except Exception:
         flags = {}
-    return {
+    snap = {
         "ts": time.time(),
         "counters": m.counters(),
         "memory": m.memory_stats(),
         "flags": flags,
     }
+    for name, (_prom, json_obj) in _provider_results():
+        snap[name] = json_obj
+    return snap
 
 
 def chrome_trace(path: str) -> None:
@@ -200,11 +203,33 @@ def jsonl(path: str) -> None:
 # -- metrics ------------------------------------------------------------------
 _METRIC_NAME = re.compile(r"[^a-zA-Z0-9_]")
 
+# Extra metric providers (serving/observe.py SLO histograms + drift gauges).
+# A provider is `fn() -> (prom_lines, json_obj)`: the lines are appended to
+# the Prometheus exposition verbatim (the provider owns its TYPE headers —
+# histogram/summary types that the counter/gauge loop above can't express)
+# and the JSON object lands in `metrics_snapshot()` under the provider's
+# name. Providers register at their module's import; a raising provider is
+# skipped, never fatal to a scrape.
+_metric_providers: Dict[str, object] = {}
+
+
+def register_metric_provider(name: str, fn) -> None:
+    _metric_providers[name] = fn
+
+
+def _provider_results():
+    for name, fn in list(_metric_providers.items()):
+        try:
+            yield name, fn()
+        except Exception:
+            continue
+
 
 def prometheus_text() -> str:
     """Prometheus text exposition format: every engine counter as a
     ``counter``, every memory gauge as a ``gauge``, prefixed
-    ``paddle_tpu_``."""
+    ``paddle_tpu_`` — plus registered provider output (serving SLO
+    histograms, derived summaries, cost-drift gauges)."""
     m = _pkg()
     lines = []
     for name, val in sorted(m.counters().items()):
@@ -215,6 +240,8 @@ def prometheus_text() -> str:
         mn = "paddle_tpu_memory_" + _METRIC_NAME.sub("_", name)
         lines.append(f"# TYPE {mn} gauge")
         lines.append(f"{mn} {int(val)}")
+    for _name, (prom_lines, _json) in _provider_results():
+        lines.extend(prom_lines)
     return "\n".join(lines) + "\n"
 
 

@@ -30,7 +30,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["Span", "span", "current_span", "active_spans"]
+__all__ = ["Span", "span", "current_span", "active_spans",
+           "add_span_observer", "remove_span_observer"]
 
 _ids = itertools.count(1)  # GIL-atomic enough; 0 means "no parent"
 _tls = threading.local()
@@ -149,6 +150,26 @@ _SPAN_ATTRS_MAX = 1 << 16  # matches the native ring capacity
 
 _pkg = None  # the parent package module, bound lazily (import-order safe)
 
+# Span observers (serving/observe.py request tracing): called with every
+# FINISHED span, synchronously on the emitting thread. The empty-tuple probe
+# is the entire disabled-path cost; observers must be cheap and never raise
+# (a raising observer is dropped from the fan-out, never from the sinks).
+_observers: tuple = ()
+_observers_lock = threading.Lock()
+
+
+def add_span_observer(fn) -> None:
+    global _observers
+    with _observers_lock:
+        if fn not in _observers:
+            _observers = _observers + (fn,)
+
+
+def remove_span_observer(fn) -> None:
+    global _observers
+    with _observers_lock:
+        _observers = tuple(o for o in _observers if o is not fn)
+
 
 def _emit(sp: Span) -> None:
     global _pkg
@@ -156,6 +177,12 @@ def _emit(sp: Span) -> None:
         import sys
 
         _pkg = sys.modules[__package__]
+    if _observers:
+        for fn in _observers:
+            try:
+                fn(sp)
+            except Exception:
+                remove_span_observer(fn)
     _pkg.flight.record(sp)
     if not _pkg._enabled:
         return

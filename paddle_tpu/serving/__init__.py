@@ -31,6 +31,17 @@ everything for a successor engine (zero-downtime upgrade). A capture that
 fails validation is a structured ``SnapshotError`` and recovery falls back
 to re-prefill — never a wrong-KV serve.
 
+SLO observability (round 20, ``serving/observe.py``): ``FLAGS_serve_trace``
+gives every request a trace id that survives preemption, crash recovery,
+snapshot re-attach and engine handoff, and collects one timeline per
+request (exportable as chrome-trace/JSONL) plus TTFT / inter-token /
+end-to-end / queue-wait histograms keyed by priority class and
+predicted-vs-actual drift gauges for the engine's three cost models;
+``FLAGS_serve_metrics_port`` serves ``/metrics``, ``/healthz``,
+``/readyz`` and ``/debug/requests`` over stdlib HTTP. Both default off —
+the flag-off scheduler never imports the module (``from paddle_tpu.serving
+import observe`` explicitly when driving it by hand).
+
 See serving/engine.py for the scheduler, serving/pool.py for the paged KV
 block allocator, serving/int8.py for the weight-only int8 path,
 serving/supervisor.py for crash/wedge recovery, and the README "Serving"
@@ -38,14 +49,14 @@ section for bucketing, backpressure, deadline/shedding and supervision
 semantics.
 """
 from .engine import (  # noqa: F401
-    DeadlineExceeded, Engine, EngineConfig, Overloaded, RequestCancelled,
-    RequestHandle, ServeError,
+    DeadlineExceeded, Engine, EngineConfig, Overloaded, Readiness,
+    RequestCancelled, RequestHandle, ServeError,
 )
 from .pool import PagePool, SnapshotError, TRASH_BLOCK  # noqa: F401
 from .supervisor import ServingSupervisor  # noqa: F401
 
 __all__ = [
     "Engine", "EngineConfig", "RequestHandle", "ServeError",
-    "RequestCancelled", "DeadlineExceeded", "Overloaded",
+    "RequestCancelled", "DeadlineExceeded", "Overloaded", "Readiness",
     "ServingSupervisor", "PagePool", "SnapshotError", "TRASH_BLOCK",
 ]

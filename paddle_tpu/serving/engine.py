@@ -92,11 +92,11 @@ import numpy as np
 from ..fault import inject as _inject
 from ..framework import flags
 from ..profiler import counter_inc, flight
-from ..profiler.spans import span
+from ..profiler.spans import span, update_attrs
 from .pool import PagePool, SnapshotError, TRASH_BLOCK
 
 __all__ = [
-    "Engine", "EngineConfig", "RequestHandle", "ServeError",
+    "Engine", "EngineConfig", "RequestHandle", "Readiness", "ServeError",
     "RequestCancelled", "DeadlineExceeded", "Overloaded", "SnapshotError",
 ]
 
@@ -132,6 +132,15 @@ class Overloaded(ServeError):
         self.retry_after_s = float(retry_after_s)
 
 
+class Readiness(dict):
+    """``ready()`` payload: a JSON-able dict (the ``/readyz`` body) whose
+    truth value is the ready bit itself, so ``if eng.ready():`` call sites
+    keep their boolean semantics."""
+
+    def __bool__(self) -> bool:
+        return bool(self.get("ready"))
+
+
 class EngineConfig:
     """Serving knobs. ``None`` fields resolve from the ``FLAGS_serve_*``
     registry at engine construction, so fleet-wide defaults are one
@@ -142,7 +151,7 @@ class EngineConfig:
                  decode_buckets=None, seed=0, max_queue=None, shed=None,
                  prefix_cache=None, spec_k=None, drafter=None,
                  draft_window=None, tp=None, prefill_chunk=None,
-                 tp_int8=None):
+                 tp_int8=None, trace=None, metrics_port=None):
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.max_batch = max_batch
@@ -165,6 +174,11 @@ class EngineConfig:
         self.tp = tp
         self.prefill_chunk = prefill_chunk
         self.tp_int8 = tp_int8
+        # SLO observability (PR 20): request tracing + token-latency
+        # histograms + cost-drift gauges, and the opt-in telemetry endpoint
+        # (0 = no HTTP thread)
+        self.trace = trace
+        self.metrics_port = metrics_port
 
     def resolve(self, model_max_positions: int) -> "EngineConfig":
         def pick(v, name):
@@ -200,6 +214,13 @@ class EngineConfig:
                                   "FLAGS_serve_prefill_chunk")
         if self.tp_int8 is None:
             self.tp_int8 = bool(flags.flag("FLAGS_serve_tp_int8", False))
+        if self.trace is None:
+            self.trace = bool(flags.flag("FLAGS_serve_trace", False))
+        self.metrics_port = int(
+            self.metrics_port if self.metrics_port is not None
+            else flags.flag("FLAGS_serve_metrics_port", 0))
+        if self.metrics_port < 0:
+            raise ValueError("serving: metrics_port must be >= 0 (0 = off)")
         if self.tp < 0:
             raise ValueError("serving: tp must be >= 0 (0/1 = single-chip)")
         if self.prefill_chunk < 0:
@@ -248,6 +269,10 @@ class _Request:
         "id", "prompt", "max_new_tokens", "eos_token_id", "temperature",
         "tokens", "error", "done", "stream_q", "cancelled",
         "t_submit", "t_done", "priority", "deadline",
+        # SLO observability (PR 20): the trace id rides the request object
+        # itself, so snapshot/harvest/handoff records (which carry requests
+        # whole) preserve it across recovery with no extra plumbing
+        "trace", "t_submit_ns", "t_first_tok", "t_last_tok",
     )
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id, temperature,
@@ -266,6 +291,10 @@ class _Request:
         self.t_done: Optional[float] = None
         self.priority = int(priority)           # higher = more important
         self.deadline = deadline                # absolute monotonic, or None
+        self.trace: Optional[str] = None        # set by observe.on_submit
+        self.t_submit_ns = 0                    # span-clock submit stamp
+        self.t_first_tok = 0.0                  # first-token wall time (TTFT)
+        self.t_last_tok = 0.0                   # last-token wall time (gaps)
 
 
 def _finish(req: _Request, tokens=None, error=None, count=True) -> bool:
@@ -694,6 +723,19 @@ class Engine:
         self._shrink_req = 0  # guarded_by: _cv
         self._oom_streak = 0
         self._unpark_countdown = 0
+        # serving SLO observability (PR 20): when armed, `_obs` is the
+        # serving.observe module and the scheduler tags spans / feeds the
+        # token-latency histograms / records cost drift. Unconfigured, the
+        # module is never even imported and every hook site is one
+        # attribute-is-None probe (inert tripwire).
+        self._t_start = time.monotonic()
+        self._obs = None
+        self._endpoint = None
+        if cfg.trace:
+            from . import observe as _observe
+
+            _observe.trace_book()  # create + register the span observer
+            self._obs = _observe
 
         # cross-thread state
         self._lock = threading.Lock()
@@ -753,12 +795,20 @@ class Engine:
         self._thread = threading.Thread(
             target=_engine_loop, args=(wr,), daemon=True, name=self._provider)
         self._thread.start()
+        # telemetry endpoint last: its handlers probe health()/stats() on a
+        # fully-constructed engine. Holds only a weakref to the engine; a
+        # failed bind is a counter, never a serving failure.
+        if cfg.metrics_port:
+            from . import observe as _observe
+
+            self._endpoint = _observe.start_endpoint(self, cfg.metrics_port)
 
     # ------------------------------------------------------------------ API
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None, temperature: float = 0.0,
                stream: bool = False, deadline_s: Optional[float] = None,
-               priority: int = 0, _shed_exempt: bool = False) -> RequestHandle:
+               priority: int = 0, _shed_exempt: bool = False,
+               _trace: Optional[str] = None) -> RequestHandle:
         """Enqueue one request (any thread). ``temperature == 0`` is greedy.
         ``stream=True`` additionally feeds the handle's iterator per token.
         ``deadline_s`` (seconds from now) attaches a completion deadline: the
@@ -821,6 +871,11 @@ class Engine:
                 self._deadline_seen = True
             if req.priority != 0:
                 self._has_prio = True
+            if self._obs is not None:
+                # assign (or, for a supervisor requeue carrying ``_trace``,
+                # re-attach) the trace id before the scheduler can see the
+                # request — the timeline must exist before its first event
+                self._obs.on_submit(req, trace=_trace)
             self._waiting.append(req)
             counter_inc("serve_requests")
             self._cv.notify()
@@ -851,6 +906,34 @@ class Engine:
             "decode_steps": self._step_i,
         }
 
+    def debug_requests(self) -> List[dict]:
+        """Live in-flight request table (``/debug/requests``): phase, age,
+        blocks held, trace id — the flight-provider data on demand instead
+        of only post-mortem. Any thread; racy snapshot by design, same
+        contract as :meth:`stats`."""
+        now = time.monotonic()
+        with self._lock:
+            waiting = list(self._waiting)
+        rows = [{
+            "id": req.id, "phase": "queued",
+            "age_s": round(now - req.t_submit, 3),
+            "priority": req.priority, "prompt_len": len(req.prompt),
+            "generated": 0, "blocks": 0, "trace": req.trace,
+        } for req in waiting]
+        for phase, seqs in (("prefilling", self._admitting),
+                            ("chunk_prefill", self._prefilling),
+                            ("running", self._running),
+                            ("preempted", self._resume)):
+            for s in list(seqs):
+                rows.append({
+                    "id": s.req.id, "phase": phase,
+                    "age_s": round(now - s.req.t_submit, 3),
+                    "priority": s.req.priority, "prompt_len": s.prompt_len,
+                    "generated": s.generated, "blocks": len(s.blocks),
+                    "trace": s.req.trace,
+                })
+        return rows
+
     def health(self) -> dict:
         """Liveness probe (any thread): scheduler-thread aliveness, heartbeat
         age, and failure state. ``ok`` is the single bit an external monitor
@@ -867,6 +950,15 @@ class Engine:
         # compile runs
         thr = max(1.0, float(flags.flag("FLAGS_serve_watchdog_s", 10.0) or 10.0))
         stale = beat_age > thr * (10.0 if self._compiling else 1.0)
+        # last adopt() outcome (reattach|reprefill), or mode "none": probes
+        # distinguish a degraded (re-prefill) recovery from clean. The
+        # internal monotonic stamp becomes an AGE — a probe scraping two
+        # replicas must not compare raw monotonic clocks across processes.
+        lr = (dict(self._last_recovery) if self._last_recovery
+              else {"mode": "none"})
+        t_rec = lr.pop("t", None)
+        if t_rec is not None:
+            lr["age_s"] = round(time.monotonic() - t_rec, 3)
         return {
             "ok": alive and self._broken is None and not stopped and not stale,
             "thread_alive": alive,
@@ -877,24 +969,32 @@ class Engine:
             "queue_depth": depth,
             "running": len(self._running),
             "pages_free": self._pool.free_blocks,
-            # last adopt() outcome (reattach|reprefill), or mode "none":
-            # probes distinguish a degraded (re-prefill) recovery from clean
-            "last_recovery": (dict(self._last_recovery)
-                              if self._last_recovery else {"mode": "none"}),
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+            "last_recovery": lr,
         }
 
-    def ready(self) -> bool:
+    def ready(self) -> "Readiness":
         """Readiness probe: accepting new submissions right now — healthy,
         not draining, and (under the shed policy) queue below the cap. The
         rolling-restart contract: flip a replica's traffic away when this
-        goes False, then ``close(drain=True)`` it."""
+        goes False, then ``close(drain=True)`` it. Returns a
+        :class:`Readiness` dict (the ``/readyz`` body) that is truthy
+        exactly when ready."""
         h = self.health()
-        if not h["ok"] or h["draining"]:
-            return False
-        cfg = self.config
-        if cfg.shed and cfg.max_queue > 0 and h["queue_depth"] >= cfg.max_queue:
-            return False
-        return True
+        ready, reason = True, None
+        if not h["ok"]:
+            ready, reason = False, "unhealthy"
+        elif h["draining"]:
+            ready, reason = False, "draining"
+        else:
+            cfg = self.config
+            if cfg.shed and cfg.max_queue > 0 \
+                    and h["queue_depth"] >= cfg.max_queue:
+                ready, reason = False, "queue_full"
+        return Readiness(ready=ready, reason=reason,
+                         queue_depth=h["queue_depth"],
+                         uptime_s=h["uptime_s"],
+                         last_recovery=h["last_recovery"])
 
     def close(self, timeout: float = 30.0, drain: bool = False) -> None:
         """Stop the engine thread. Plain ``close()`` fails outstanding
@@ -921,6 +1021,9 @@ class Engine:
         # when the loop's deref holds the last reference); same for this
         # engine's watchdog unit record — stale units must not outlive it
         flight.remove_context_provider(self._provider)
+        if self._endpoint is not None:
+            self._endpoint.close()
+            self._endpoint = None
         from ..fault import memory as _fmem
 
         _fmem.unregister_pressure_handler(self._provider)
@@ -1071,7 +1174,9 @@ class Engine:
                 info["reject_reason"] = str(e)
             info["duration_s"] = round(time.monotonic() - t0, 6)
             sp.set(mode=info["mode"])
-        self._last_recovery = info
+        # stamped copy: health() turns "t" into an age; the caller's info
+        # dict stays exactly the documented shape
+        self._last_recovery = dict(info, t=time.monotonic())
         counter_inc("serve_adoptions")
         return info
 
@@ -1445,8 +1550,25 @@ class Engine:
                 else:
                     self._decode()
             sp.set(running_after=len(self._running))
+            if self._obs is not None and flags.flag(
+                    "FLAGS_hbm_admission", "off") != "off":
+                # drift predictor (b): the admission preflight's predicted
+                # peak vs the realized post-step live census. Only priced
+                # when admission is armed — preflight already pays a census
+                # per dispatch, so this adds one more per scheduler step.
+                self._hbm_drift()
             self._oom_streak = 0
             self._maybe_unpark()
+
+    def _hbm_drift(self):
+        from .. import profiler as _prof
+        from ..fault import memory as _fmem
+
+        pred = _fmem.last_prediction().get("hbm_predicted_peak_bytes")
+        if pred:
+            live = int(_prof.memory_census().get("live_bytes", 0))
+            if live:
+                self._obs.drift("hbm_admission", pred, live)
 
     # clean scheduler steps (work done, no OOM) before parked blocks start
     # returning to circulation; halved-back gradually so a recurrence
@@ -1597,6 +1719,9 @@ class Engine:
                                  f"{req.deadline - now:.3f}s"))
         for req, why in shed:
             counter_inc("serve_deadline_shed")
+            if self._obs is not None:
+                self._obs.on_shed(
+                    req, "expired" if now >= (req.deadline or now) else "doomed")
             self._finish_request(req, error=DeadlineExceeded(
                 f"request {req.id} {why}", request_id=req.id))
         for seq in [s for s in self._running
@@ -1705,6 +1830,10 @@ class Engine:
                 seq.blocks = matched + blocks
                 seq.cached_blocks = len(matched)
                 admitted.append(seq)
+                if self._obs is not None and matched:
+                    self._obs.on_prefix_match(
+                        seq.req, len(matched) * self.config.block_size,
+                        len(matched))
             self._resume = still_resume
             # ONE ordered snapshot per admission pass, not an O(queue) scan
             # per batch slot: strict priority order, FIFO within a class,
@@ -1751,6 +1880,12 @@ class Engine:
                 seq.blocks = matched + blocks
                 seq.cached_blocks = len(matched)
                 admitted.append(seq)
+                if self._obs is not None:
+                    self._obs.on_admit(req)
+                    if matched:
+                        self._obs.on_prefix_match(
+                            req, len(matched) * self.config.block_size,
+                            len(matched))
             if admitted:
                 counter_inc("serve_admitted", len(admitted))
             sp.set(admitted=len(admitted), resume_waiting=len(self._resume))
@@ -1777,7 +1912,9 @@ class Engine:
             for i in range(0, len(group), bw):
                 chunk = group[i:i + bw]
                 with span("prefill", bucket_t=t_bucket, bucket_b=bw,
-                          rows=len(chunk)):
+                          rows=len(chunk)) as sp:
+                    if self._obs is not None:
+                        sp.set(traces=tuple(s.req.trace for s in chunk))
                     # heartbeat before a potentially-long op (first-call jit
                     # compile): the supervisor's staleness clock starts HERE,
                     # so only a genuinely wedged op trips it
@@ -1811,7 +1948,9 @@ class Engine:
             for i in range(0, len(group), bw):
                 chunk = group[i:i + bw]
                 with span("prefill", bucket_t=t_bucket, bucket_b=bw,
-                          rows=len(chunk), shared=True):
+                          rows=len(chunk), shared=True) as sp:
+                    if self._obs is not None:
+                        sp.set(traces=tuple(s.req.trace for s in chunk))
                     self._beat = time.monotonic()
                     n_fns = len(self._fns)
                     fn = self._get_fn("prefill_tail", bw, t_bucket)
@@ -1853,6 +1992,10 @@ class Engine:
             self._append_token(s, self._sample_host(rows[r], s.req))
             if not s.req.done.is_set():
                 self._running.append(s)
+        if self._obs is not None:
+            # ONE host clock read covers the whole landed group: prefill
+            # always emits each row's first token (TTFT)
+            self._obs.on_tokens([s.req for s in chunk], time.monotonic())
 
     # -- chunked prefill (PR 19) ---------------------------------------------
     def _chunk_divert(self, seqs: List[_Seq]) -> List[_Seq]:
@@ -1890,7 +2033,9 @@ class Engine:
                  for s in batch]
         t_bucket = self._bucket_for(max(feeds))
         with span("prefill", bucket_t=t_bucket, bucket_b=bw,
-                  rows=len(batch), chunked=True):
+                  rows=len(batch), chunked=True) as sp:
+            if self._obs is not None:
+                sp.set(traces=tuple(s.req.trace for s in batch))
             self._beat = time.monotonic()
             n_fns = len(self._fns)
             fn = self._get_fn("prefill_tail", bw, t_bucket)
@@ -1978,7 +2123,9 @@ class Engine:
                 self._evict(victim)
 
     def _evict(self, seq: _Seq):
-        with span("evict", request=seq.req.id, generated=seq.generated):
+        with span("evict", request=seq.req.id, generated=seq.generated) as sp:
+            if self._obs is not None:
+                sp.set(traces=(seq.req.trace,))
             self._pool.free(seq.blocks)
             seq.blocks = []
             self._running.remove(seq)
@@ -2034,6 +2181,8 @@ class Engine:
             seq.blocks[col] = new
             self._pool.free([bid])
             counter_inc("serve_cow_copies")
+            if self._obs is not None:
+                self._obs.on_cow(seq.req.trace, 1)
 
     def _decode(self):
         jnp, jax = self._jnp, self._jax
@@ -2059,7 +2208,9 @@ class Engine:
         # a width upgrade pops the old entry, so compare by key presence,
         # not _fns length
         warm = ("decode", bb, mb) in self._fns
-        with span("decode_step", bucket=bb, rows=n, step=self._step_i):
+        with span("decode_step", bucket=bb, rows=n, step=self._step_i) as sp:
+            if self._obs is not None:
+                sp.set(traces=tuple(s.req.trace for s in self._running))
             self._beat = time.monotonic()  # staleness clock covers this op
             fn = self._get_fn("decode", bb, mb)
             self._compiling = not warm
@@ -2077,6 +2228,12 @@ class Engine:
         # deadline look doomed
         if warm:
             dt = time.monotonic() - t0
+            if self._obs is not None and self._ema_step_s:
+                # drift predictor (a): the shed-ETA per-step estimate the
+                # sweep would have used for THIS step vs its measured time
+                rel = self._obs.drift(
+                    "step_eta", max(self._ema_step_s, self._step_floor_s), dt)
+                update_attrs(sp, cost_drift=round(rel, 6))
             self._ema_step_s = (dt if not self._ema_step_s
                                 else 0.8 * self._ema_step_s + 0.2 * dt)
         self._step_i += 1
@@ -2085,8 +2242,13 @@ class Engine:
         counter_inc("serve_decode_steps")
         counter_inc("serve_occupancy_live", n)
         counter_inc("serve_occupancy_slots", bb)
-        for r, s in enumerate(list(self._running)):
+        rows_live = list(self._running)
+        for r, s in enumerate(rows_live):
             self._append_token(s, int(nxt[r]))
+        if self._obs is not None:
+            # one host clock read at step retire, attributed to every row
+            # that emitted a token this step (TTFT / inter-token gap)
+            self._obs.on_tokens([s.req for s in rows_live], time.monotonic())
 
     # -- speculative decode ---------------------------------------------------
     def _propose(self, bb: int) -> np.ndarray:
@@ -2156,6 +2318,8 @@ class Engine:
         warm = ("spec", bb, mb) in self._fns
         with span("decode_step", bucket=bb, rows=n, step=self._step_i,
                   spec_k=k) as sp:
+            if self._obs is not None:
+                sp.set(traces=tuple(s.req.trace for s in self._running))
             self._beat = time.monotonic()
             fn = self._get_fn("spec", bb, mb)
             self._compiling = not warm
@@ -2167,7 +2331,8 @@ class Engine:
             )
             greedy, sampled = np.asarray(greedy), np.asarray(sampled)
             proposed = accepted = 0
-            for r, s in enumerate(list(self._running)):
+            rows_live = list(self._running)
+            for r, s in enumerate(rows_live):
                 if temps[r] > 0.0:
                     self._append_token(s, int(sampled[r]))
                     continue
@@ -2186,8 +2351,17 @@ class Engine:
             sp.set(drafted=proposed, accepted=accepted)
         self._beat = time.monotonic()
         self._compiling = False
+        if self._obs is not None:
+            # every live row emits at least its j=0 token per spec step; the
+            # gap histogram sees one sample per row per step (a multi-accept
+            # step IS one inter-token interval at step granularity)
+            self._obs.on_tokens([s.req for s in rows_live], time.monotonic())
         if warm:
             dt = time.monotonic() - t0
+            if self._obs is not None and self._ema_step_s:
+                rel = self._obs.drift(
+                    "step_eta", max(self._ema_step_s, self._step_floor_s), dt)
+                update_attrs(sp, cost_drift=round(rel, 6))
             self._ema_step_s = (dt if not self._ema_step_s
                                 else 0.8 * self._ema_step_s + 0.2 * dt)
         self._step_i += 1
@@ -2222,12 +2396,15 @@ class Engine:
         self._finish_request(seq.req, tokens=seq.tokens, error=error)
 
     def _finish_request(self, req: _Request, tokens=None, error=None):
-        if _finish(req, tokens=tokens, error=error) and error is None:
-            # completed-request latency EMA drives the Overloaded
-            # retry_after_s hint
-            lat = req.t_done - req.t_submit
-            self._ema_req_s = (lat if not self._ema_req_s
-                               else 0.8 * self._ema_req_s + 0.2 * lat)
+        if _finish(req, tokens=tokens, error=error):
+            if self._obs is not None:
+                self._obs.on_done(req, error)
+            if error is None:
+                # completed-request latency EMA drives the Overloaded
+                # retry_after_s hint
+                lat = req.t_done - req.t_submit
+                self._ema_req_s = (lat if not self._ema_req_s
+                                   else 0.8 * self._ema_req_s + 0.2 * lat)
 
     # -- cancellation / teardown ---------------------------------------------
     def _cancel(self, req: _Request):

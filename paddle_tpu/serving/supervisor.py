@@ -49,6 +49,7 @@ tests/test_serving_resilience.py and tests/test_serving_snapshot.py.
 """
 from __future__ import annotations
 
+import copy
 import itertools
 import queue as _queue
 import threading
@@ -60,8 +61,8 @@ from ..framework import flags
 from ..profiler import counter_inc, flight
 from ..profiler.spans import span
 from .engine import (
-    DeadlineExceeded, Engine, RequestHandle, ServeError, SnapshotError,
-    _finish,
+    DeadlineExceeded, Engine, Readiness, RequestHandle, ServeError,
+    SnapshotError, _finish,
 )
 
 __all__ = ["ServingSupervisor"]
@@ -112,6 +113,14 @@ def _relay_many(pairs) -> None:
                 else:
                     _finish(req, tokens=inner.tokens, count=False)
                 counter_inc("serve_relayed")
+                if req.trace is not None:
+                    # recovered-request timeline: the relay is the last hop
+                    from . import observe as _observe
+
+                    _observe.on_relay(
+                        req, len(inner.tokens or ()),
+                        None if inner.error is None
+                        else type(inner.error).__name__)
             else:
                 still.append((req, handle))
         pending = still
@@ -206,6 +215,21 @@ class ServingSupervisor:
         self._model = model
         self._config = config
         self._overrides = dict(overrides)
+        self._t_start = time.monotonic()
+        # telemetry endpoint (PR 20): the SUPERVISOR owns the port — probes
+        # must survive engine restarts, and a replacement engine re-binding
+        # the same port mid-recovery would race the dying one. Engines are
+        # spawned with metrics_port=0 so they never bind their own.
+        if config is not None:
+            self._config = copy.copy(config)
+            self._metrics_port = self._config.metrics_port
+            self._config.metrics_port = 0
+        else:
+            self._metrics_port = self._overrides.pop("metrics_port", None)
+            self._overrides["metrics_port"] = 0
+        if self._metrics_port is None:
+            self._metrics_port = flags.flag("FLAGS_serve_metrics_port", 0)
+        self._metrics_port = int(self._metrics_port or 0)
         self._lock = threading.Lock()
         self._engine: Optional[Engine] = self._spawn()  # guarded_by: _lock
         self._restarts = 0                              # guarded_by: _lock
@@ -228,6 +252,11 @@ class ServingSupervisor:
             target=_monitor_loop, args=(wr,), daemon=True,
             name=self._provider)
         self._monitor.start()
+        self._endpoint = None
+        if self._metrics_port:
+            from . import observe as _observe
+
+            self._endpoint = _observe.start_endpoint(self, self._metrics_port)
 
     def _spawn(self) -> Engine:
         eng = Engine(self._model, config=self._config, **self._overrides)
@@ -279,16 +308,24 @@ class ServingSupervisor:
             return self._restarts
 
     def health(self) -> dict:
-        """Engine liveness + supervisor state; ``ok`` requires both."""
+        """Engine liveness + supervisor state; ``ok`` requires both. The
+        ``_engine`` read is under ``_lock``, so after a restart the probe's
+        heartbeat/uptime fields are the REPLACEMENT engine's (its
+        ``uptime_s`` restarts young; ``supervisor_uptime_s`` is the
+        process-level monotonic clock)."""
         with self._lock:
             eng, restarts, broken = self._engine, self._restarts, self._broken
             last = dict(self._last_recovery)
+        t_rec = last.pop("t", None)
+        if t_rec is not None:
+            last["age_s"] = round(time.monotonic() - t_rec, 3)
         h = eng.health() if eng is not None else {"ok": False}
         h.update(
             restarts=restarts,
             max_restarts=self.max_restarts,
             watchdog_s=self.watchdog_s,
             supervisor_ok=broken is None,
+            supervisor_uptime_s=round(time.monotonic() - self._t_start, 3),
             # supervisor-level record wins over the engine's adopt()-local
             # view: it also covers requeue-only and wedge recoveries
             last_recovery=last,
@@ -296,12 +333,21 @@ class ServingSupervisor:
         h["ok"] = bool(h.get("ok") and broken is None)
         return h
 
-    def ready(self) -> bool:
+    def ready(self) -> "Readiness":
         with self._lock:
-            if self._broken is not None or self._engine is None:
-                return False
+            broken, eng = self._broken, self._engine
+        sup_up = round(time.monotonic() - self._t_start, 3)
+        if broken is not None or eng is None:
+            return Readiness(ready=False, reason="supervisor_broken",
+                             supervisor_uptime_s=sup_up)
+        r = eng.ready()
+        r["supervisor_uptime_s"] = sup_up
+        return r
+
+    def debug_requests(self) -> list:
+        with self._lock:
             eng = self._engine
-        return eng.ready()
+        return [] if eng is None else eng.debug_requests()
 
     def close(self, timeout: float = 30.0, drain: bool = False) -> None:
         """Stop monitoring, then the engine (``drain=True`` completes queued
@@ -326,6 +372,9 @@ class ServingSupervisor:
             relays = list(self._relays)
         for t in relays:  # their continuation handles just failed/finished
             t.join(timeout=2.0)
+        if self._endpoint is not None:
+            self._endpoint.close()
+            self._endpoint = None
         flight.remove_context_provider(self._provider)
 
     def __enter__(self):
@@ -429,6 +478,8 @@ class ServingSupervisor:
                 0 if info is None else info.get("reprefill_tokens_saved", 0)),
             "requeued": 0 if info is None else info.get("requeued", 0),
             "duration_s": round(dur, 6),
+            # monotonic stamp; health() reports it as age_s, never raw
+            "t": time.monotonic(),
         }
         with self._lock:
             self._last_recovery = rec
@@ -575,12 +626,14 @@ class ServingSupervisor:
         try:
             # _shed_exempt: the old engine already ACCEPTED this work — its
             # own recovery must not fast-fail it with Overloaded
+            # _trace: the continuation inherits the original's trace id, so
+            # the recovered request keeps ONE timeline across engines
             h = new.submit(prompt, max_new_tokens=remaining,
                            eos_token_id=req.eos_token_id,
                            temperature=req.temperature,
                            stream=req.stream_q is not None,
                            deadline_s=dl, priority=req.priority,
-                           _shed_exempt=True)
+                           _shed_exempt=True, _trace=req.trace)
         except Exception as e:  # lint: ok(oom-handler) — submit() only enqueues; prefill dispatch happens on the engine thread
             _finish(req, error=e if isinstance(e, ServeError)
                     else ServeError(f"requeue after restart failed: {e!r}"))

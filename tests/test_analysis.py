@@ -344,6 +344,123 @@ class TestFlagRegistryRule:
         assert findings == []
 
 
+class TestCounterRegistryRule:
+    @staticmethod
+    def _pkg(tmp_path, registered, documented, mod_src):
+        pkg = tmp_path / "pkg"
+        (pkg / "profiler").mkdir(parents=True)
+        doc = ", ".join(f"``{n}``" for n in documented)
+        (pkg / "profiler" / "__init__.py").write_text(
+            "def counters():\n"
+            f'    """Counter snapshot.\n\n    Telemetry: {doc}.\n    """\n'
+            "    return {}\n\n"
+            "KNOWN_COUNTERS = frozenset({"
+            + ", ".join(repr(n) for n in sorted(registered)) + "})\n"
+        )
+        (pkg / "mod.py").write_text(textwrap.dedent(mod_src))
+        return pkg
+
+    @staticmethod
+    def _findings(pkg):
+        return [f for f in lint_mod.lint_package(str(pkg))
+                if f.rule == "counter-registry"]
+
+    def test_bumped_but_unregistered_reported_at_bump_site(self, tmp_path):
+        pkg = self._pkg(tmp_path, {"good"}, {"good"}, """
+        from .profiler import counter_inc
+        def f():
+            counter_inc("good")
+            counter_inc("ghost")
+        """)
+        bad = self._findings(pkg)
+        assert len(bad) == 1
+        assert "'ghost'" in bad[0].message and "KNOWN_COUNTERS" in bad[0].message
+        assert bad[0].path == "mod.py" and bad[0].scope == "f"
+
+    def test_registered_but_never_bumped(self, tmp_path):
+        pkg = self._pkg(tmp_path, {"good", "stale"}, {"good", "stale"}, """
+        from .profiler import counter_inc
+        def f():
+            counter_inc("good")
+        """)
+        bad = self._findings(pkg)
+        assert len(bad) == 1
+        assert "'stale'" in bad[0].message and "never" in bad[0].message
+        assert bad[0].path == "profiler/__init__.py"
+
+    def test_registered_but_undocumented(self, tmp_path):
+        pkg = self._pkg(tmp_path, {"good", "undoc"}, {"good"}, """
+        from .profiler import counter_inc
+        def f():
+            counter_inc("good")
+            counter_inc("undoc")
+        """)
+        bad = self._findings(pkg)
+        assert len(bad) == 1
+        assert "'undoc'" in bad[0].message and "docstring" in bad[0].message
+        assert bad[0].scope == "counters"
+
+    def test_ifexp_branches_counted_test_strings_not(self, tmp_path):
+        """`counter_inc("a" if kind == "wedge" else "b")` bumps a AND b;
+        the predicate's "wedge" literal is NOT a counter name (the false
+        positive the first implementation hit on supervisor.py)."""
+        pkg = self._pkg(tmp_path, {"a", "b"}, {"a", "b"}, """
+        from .profiler import counter_inc
+        def f(kind):
+            counter_inc("a" if kind == "wedge" else "b")
+        """)
+        assert self._findings(pkg) == []
+        # drop b from the registry: the branch ref surfaces it
+        pkg2 = self._pkg(tmp_path / "two", {"a"}, {"a"}, """
+        from .profiler import counter_inc
+        def f(kind):
+            counter_inc("a" if kind == "wedge" else "b")
+        """)
+        bad = self._findings(pkg2)
+        assert [f for f in bad if "'b'" in f.message]
+        assert not [f for f in bad if "wedge" in f.message]
+
+    def test_step_counters_dict_keys_are_bumps(self, tmp_path):
+        """A step_counters() dict is fed verbatim into counter_inc(k, v)
+        by the distributed engine — its keys count as bump sites."""
+        pkg = self._pkg(tmp_path, {"sc_a"}, {"sc_a"}, """
+        def step_counters():
+            return {"sc_a": 1}
+        """)
+        assert self._findings(pkg) == []
+        pkg2 = self._pkg(tmp_path / "two", set(), set(), """
+        def step_counters():
+            return {"sc_a": 1}
+        """)
+        # empty frozenset({}) registers nothing -> rule disengages; seed one
+        # registered name so the registry exists
+        pkg2 = self._pkg(tmp_path / "three", {"other"}, {"other"}, """
+        from .profiler import counter_inc
+        def step_counters():
+            return {"sc_a": 1}
+        def g():
+            counter_inc("other")
+        """)
+        bad = self._findings(pkg2)
+        assert len(bad) == 1 and "'sc_a'" in bad[0].message
+
+    def test_package_without_registry_disengages(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            "from x import counter_inc\n"
+            'counter_inc("anything_at_all")\n'
+        )
+        assert self._findings(pkg) == []
+
+    def test_installed_tree_counters_all_registered(self):
+        findings = [
+            f for f in lint_mod.lint_package(analysis.package_root())
+            if f.rule == "counter-registry"
+        ]
+        assert findings == []
+
+
 class TestBaselineGrammar:
     def test_missing_justification_rejected(self, tmp_path):
         p = tmp_path / "baseline.txt"

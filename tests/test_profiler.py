@@ -298,7 +298,11 @@ class TestExporters:
         for line in text.splitlines():
             if not line.startswith("#"):
                 name, val = line.rsplit(" ", 1)
-                int(val)  # every sample parses as an integer
+                float(val)  # every sample parses as a number...
+                if name.startswith(("paddle_tpu_lazy", "paddle_tpu_memory_")):
+                    int(val)  # ...counters and memory gauges as integers
+                    # (provider lines — serving SLO histograms, drift/rate
+                    # gauges — are legitimately floats)
 
     def test_export_metrics_json_file(self, tmp_path):
         out = tmp_path / "metrics.json"

@@ -1,0 +1,628 @@
+"""Serving SLO observability (ISSUE 20) — request-scoped tracing,
+token-latency histograms, the telemetry endpoint, and cost-model drift.
+
+Pins the acceptance surface on the tier-1 (in-process, CPU-fast) side:
+
+* a traced multi-stream drive yields exactly ONE completed timeline per
+  request (queue → prefill → decode steps), with EXACT histogram counts
+  (TTFT / inter-token / e2e / queue-wait) keyed by priority class;
+* the trace id survives the four hard paths — preemption + re-prefill,
+  supervisor crash recovery (with and without snapshot re-attach),
+  engine→engine handoff, and chunked prefill — one timeline per request,
+  no orphan or duplicate trace ids;
+* ``/metrics`` is valid Prometheus text with le-cumulative histograms,
+  ``/healthz`` flips 200→503 on an injected wedge, ``/readyz`` follows
+  the rolling-restart contract, ``/debug/requests`` shows live trace ids,
+  and the supervisor owns the port across a restart;
+* all three cost-model drift gauges (step_eta, hbm_admission,
+  kernel_estimate) go live from their real call sites;
+* the whole layer is inert when unconfigured: no import, no threads, and
+  monkeypatch-exploded hooks prove the flag-off scheduler never calls one.
+"""
+import contextlib
+import json
+import re
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import profiler
+from paddle_tpu.fault import inject
+from paddle_tpu.framework import flags
+from paddle_tpu.serving import (
+    Engine, Readiness, ServeError, ServingSupervisor, observe,
+)
+from serving_util import ENGINE_KW, make_prompts as _prompts, tiny_gpt
+
+_KW = dict(ENGINE_KW)
+_TRACED = dict(_KW, trace=True)
+_MISS = object()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_gpt()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    observe.reset()
+    yield
+    inject.disarm()
+    observe.reset()
+
+
+@contextlib.contextmanager
+def _flags(**kv):
+    old = {k: flags._FLAGS.get(k, _MISS) for k in kv}
+    flags._FLAGS.update(kv)
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is _MISS:
+                flags._FLAGS.pop(k, None)
+            else:
+                flags._FLAGS[k] = v
+
+
+def _drive(eng, prompts, max_new=4, **kw):
+    hs = [eng.submit(p, max_new_tokens=max_new, **kw) for p in prompts]
+    return [h.result(timeout=600) for h in hs]
+
+
+def _get(port, path):
+    from urllib.error import HTTPError
+    from urllib.request import urlopen
+
+    try:
+        with urlopen(f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return r.status, r.read().decode()
+    except HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _event_names(tl):
+    return [ev["name"] for ev in tl["events"]]
+
+
+# -- timelines + exact histogram counts ---------------------------------------
+
+class TestTimelines:
+    def test_multi_stream_drive_one_timeline_per_request(self, model, tmp_path):
+        """THE acceptance pin: a 64-stream drive yields one complete
+        timeline per request (queue → prefill → decode_step, outcome ok)
+        with unique trace ids and EXACT histogram counts."""
+        rng = np.random.RandomState(40)
+        prompts = _prompts(64, rng)
+        max_new = 4
+        with Engine(model, **_TRACED) as eng:
+            outs = _drive(eng, prompts, max_new=max_new)
+        assert all(len(o) == len(p) + max_new
+                   for o, p in zip(outs, prompts))
+        book = observe.trace_book()
+        done = book.completed()
+        assert len(done) == 64
+        assert len({tl["trace"] for tl in done}) == 64  # no dup/orphan ids
+        assert book.open_traces() == {}                 # nothing leaked open
+        for tl in done:
+            names = _event_names(tl)
+            assert tl["outcome"] == "ok"
+            assert "queue" in names
+            assert "prefill" in names
+            assert "decode_step" in names
+            assert tl["t_close"] >= tl["t_open"]
+        # exact SLO histogram counts: 1 TTFT + 1 e2e + 1 queue wait per
+        # request, max_new-1 inter-token gaps (prefill emits token #1)
+        snap = observe.slo().snapshot()
+
+        def total(metric):
+            return sum(s["count"] for s in snap.get(metric, {}).values())
+
+        assert total("serve_ttft_seconds") == 64
+        assert total("serve_e2e_seconds") == 64
+        assert total("serve_queue_seconds") == 64
+        assert total("serve_inter_token_seconds") == 64 * (max_new - 1)
+        # exports: chrome-trace document + one JSONL line per timeline
+        ct = tmp_path / "trace.json"
+        jl = tmp_path / "trace.jsonl"
+        book.chrome_trace(str(ct))
+        book.jsonl(str(jl))
+        doc = json.loads(ct.read_text())
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert len(metas) == 64  # one display thread per request
+        lines = [json.loads(ln) for ln in jl.read_text().splitlines()]
+        assert len(lines) == 64
+        assert {ln["trace"] for ln in lines} == {tl["trace"] for tl in done}
+
+    def test_priority_classes_key_histograms(self, model):
+        rng = np.random.RandomState(41)
+        prompts = _prompts(8, rng)
+        with Engine(model, **_TRACED) as eng:
+            hs = [eng.submit(p, max_new_tokens=3, priority=i % 2)
+                  for i, p in enumerate(prompts)]
+            for h in hs:
+                h.result(timeout=300)
+        snap = observe.slo().snapshot()["serve_ttft_seconds"]
+        assert set(snap) == {"0", "1"}
+        assert snap["0"]["count"] == 4
+        assert snap["1"]["count"] == 4
+        # percentile merges classes unless one is named
+        assert observe.percentile("serve_ttft_seconds", 0.5) > 0.0
+        assert observe.percentile("serve_ttft_seconds", 0.5, priority=1) > 0.0
+
+    def test_histogram_bucket_semantics(self):
+        h = observe.Histogram((0.1, 1.0))
+        for v in (0.05, 0.1, 0.5, 5.0):
+            h.observe(v)
+        s = h.snapshot()
+        assert s["counts"] == [2, 1, 1]       # le=0.1 holds 0.05 AND 0.1
+        assert s["cumulative"] == [2, 3, 4]   # Prometheus le-cumulative
+        assert s["count"] == 4
+        assert abs(s["sum"] - 5.65) < 1e-9
+
+    def test_percentile_empty_is_zero(self):
+        assert observe.percentile("serve_ttft_seconds", 0.99) == 0.0
+
+
+# -- trace-id continuity across the hard paths --------------------------------
+
+class TestTraceContinuity:
+    def test_preemption_reprefill_stays_one_timeline(self, model):
+        """Pool pressure forces evict + re-prefill: the victim's timeline
+        keeps its trace id — evict and BOTH prefills land on ONE record."""
+        rng = np.random.RandomState(42)
+        prompts = [rng.randint(0, 211, (8,)).tolist() for _ in range(4)]
+        with Engine(model, trace=True, block_size=8, num_blocks=10,
+                    max_batch=4, max_seq_len=72) as eng:
+            outs = _drive(eng, prompts, max_new=24)
+        assert all(len(o) == 32 for o in outs)
+        done = observe.trace_book().completed()
+        assert len(done) == 4
+        assert len({tl["trace"] for tl in done}) == 4
+        assert observe.trace_book().open_traces() == {}
+        assert all(tl["outcome"] == "ok" for tl in done)
+        evicted = [tl for tl in done if "evict" in _event_names(tl)]
+        assert evicted, "geometry must force at least one eviction"
+        for tl in evicted:
+            # re-admission re-prefills: ≥ 2 prefill events, same timeline
+            assert _event_names(tl).count("prefill") >= 2
+
+    def test_crash_recovery_trace_continuity(self, model):
+        """A supervised crash requeues work as continuation requests that
+        RE-ATTACH the original trace ids: one timeline per request, with
+        the recovery relay as the last hop, and bit-identical output."""
+        rng = np.random.RandomState(43)
+        prompts = _prompts(6, rng)
+        with Engine(model, **_KW) as eng:
+            baseline = _drive(eng, prompts, max_new=8)
+        observe.reset()
+        inject.arm("serve.crash:at=4")  # 4th scheduler step: mid-decode
+        with ServingSupervisor(model, watchdog_s=4.0, **_TRACED) as sup:
+            outs = _drive(sup, prompts, max_new=8)
+            assert sup.restarts == 1
+        assert outs == baseline
+        done = observe.trace_book().completed()
+        assert len(done) == 6
+        assert len({tl["trace"] for tl in done}) == 6
+        assert observe.trace_book().open_traces() == {}
+        assert all(tl["outcome"] == "ok" for tl in done)
+        # the relay lands on the recovered requests' timelines (done-ring
+        # fallback: the continuation may close before the relay thread runs)
+        relayed = [tl for tl in done if "relay" in _event_names(tl)]
+        assert relayed, "crash recovery must stamp relay events"
+        for tl in relayed:
+            ev = [e for e in tl["events"] if e["name"] == "relay"][-1]
+            assert ev["attrs"]["error"] is None
+
+    def test_snapshot_reattach_trace_continuity(self, model):
+        """Crash recovery through the snapshot re-attach path keeps the
+        same one-timeline-per-request contract."""
+        rng = np.random.RandomState(44)
+        prompts = _prompts(6, rng)
+        with Engine(model, **_KW) as eng:
+            baseline = _drive(eng, prompts, max_new=8)
+        observe.reset()
+        inject.arm("serve.crash:at=4")
+        with ServingSupervisor(model, watchdog_s=4.0, snapshot=True,
+                               **_TRACED) as sup:
+            outs = _drive(sup, prompts, max_new=8)
+            assert sup.restarts == 1
+            assert sup.health()["last_recovery"]["mode"] in (
+                "reattach", "reprefill")
+        assert outs == baseline
+        done = observe.trace_book().completed()
+        assert len(done) == 6
+        assert len({tl["trace"] for tl in done}) == 6
+        assert observe.trace_book().open_traces() == {}
+        assert all(tl["outcome"] == "ok" for tl in done)
+
+    def test_handoff_trace_continuity(self, model):
+        """Engine→engine handoff: the successor's spans land on the SAME
+        timelines the predecessor opened (the book is process-global)."""
+        rng = np.random.RandomState(45)
+        prompts = _prompts(6, rng)
+        old = Engine(model, **_TRACED)
+        try:
+            hs = [old.submit(p, max_new_tokens=10) for p in prompts]
+            deadline = time.monotonic() + 30
+            while old.stats()["decode_steps"] < 2 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            snap = old.handoff()
+            with Engine(model, **_TRACED) as new:
+                info = new.adopt(snap)
+                assert info["mode"] == "reattach"
+                outs = [h.result(timeout=600) for h in hs]
+        finally:
+            old.close()
+        assert all(len(o) == len(p) + 10 for o, p in zip(outs, prompts))
+        done = observe.trace_book().completed()
+        assert len(done) == 6
+        assert len({tl["trace"] for tl in done}) == 6
+        assert observe.trace_book().open_traces() == {}
+        assert all(tl["outcome"] == "ok" for tl in done)
+
+    def test_chunked_prefill_single_timeline(self, model):
+        """A chunked prefill is several prefill spans on ONE timeline."""
+        rng = np.random.RandomState(46)
+        prompts = [rng.randint(0, 211, (n,)).tolist() for n in (40, 61)]
+        c0 = profiler.counters().get("serve_prefill_chunks", 0)
+        with Engine(model, prefill_chunk=8, **_TRACED) as eng:
+            outs = _drive(eng, prompts, max_new=4)
+        assert all(len(o) == len(p) + 4 for o, p in zip(outs, prompts))
+        assert profiler.counters().get("serve_prefill_chunks", 0) > c0
+        done = observe.trace_book().completed()
+        assert len(done) == 2
+        assert len({tl["trace"] for tl in done}) == 2
+        long_tl = next(tl for tl in done if tl["prompt_len"] == 61)
+        chunks = [e for e in long_tl["events"]
+                  if e["name"] == "prefill" and e["attrs"].get("chunked")]
+        assert len(chunks) >= 2
+
+
+# -- telemetry endpoint -------------------------------------------------------
+
+_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"(NaN|[-+]?(\d+(\.\d*)?|\.\d+)([eE][-+]?\d+)?)$")
+_TTFT_BUCKET = re.compile(
+    r'^paddle_tpu_serve_ttft_seconds_bucket'
+    r'\{priority="(\d+)",le="([^"]+)"\} (\d+)$')
+
+
+class TestEndpoint:
+    def test_metrics_is_valid_prometheus(self, model):
+        rng = np.random.RandomState(47)
+        prompts = _prompts(8, rng)
+        with Engine(model, **_TRACED) as eng:
+            _drive(eng, prompts, max_new=3)
+            ep = observe.start_endpoint(eng, 0)  # port 0: bind ephemeral
+            try:
+                code, body = _get(ep.port, "/metrics")
+            finally:
+                ep.close()
+        assert code == 200
+        lines = [ln for ln in body.splitlines() if ln]
+        for ln in lines:
+            if not ln.startswith("#"):
+                assert _SAMPLE.match(ln), f"invalid exposition line: {ln!r}"
+        # TTFT histogram: le-cumulative monotone per priority, +Inf == count
+        cum = {}
+        for ln in lines:
+            m = _TTFT_BUCKET.match(ln)
+            if m:
+                prio, le, v = m.group(1), m.group(2), int(m.group(3))
+                assert v >= cum.get(prio, (0, None))[0], ln
+                cum[prio] = (v, le)
+        assert cum, "TTFT histogram missing from /metrics"
+        assert all(last_le == "+Inf" for _, last_le in cum.values())
+        assert sum(v for v, _ in cum.values()) == 8
+        counts = {m.group(1): int(m.group(2)) for m in re.finditer(
+            r'paddle_tpu_serve_ttft_seconds_count\{priority="(\d+)"\} (\d+)',
+            body)}
+        assert sum(counts.values()) == 8
+        # derived summary + shed-rate gauges ride along
+        assert "# TYPE paddle_tpu_serve_e2e_latency summary" in body
+        assert "paddle_tpu_serve_shed_rate" in body
+
+    def test_healthz_flips_on_injected_wedge(self, model):
+        """/healthz 200 on a live engine, 503 once the injected wedge makes
+        the heartbeat stale (the acceptance pin for the liveness route)."""
+        rng = np.random.RandomState(48)
+        with _flags(FLAGS_serve_watchdog_s=2.0):
+            eng = Engine(model, **_KW)
+            ep = observe.start_endpoint(eng, 0)
+            try:
+                eng.generate(rng.randint(0, 211, (5,)).tolist(),
+                             max_new_tokens=3)  # warm: no compile grace
+                code, body = _get(ep.port, "/healthz")
+                assert code == 200 and json.loads(body)["ok"]
+                code, body = _get(ep.port, "/readyz")
+                assert code == 200 and json.loads(body)["ready"]
+                inject.arm("serve.wedge:at=1,ms=60000")
+                eng.submit(rng.randint(0, 211, (5,)).tolist(),
+                           max_new_tokens=20)
+                deadline = time.monotonic() + 30
+                while eng.health()["ok"] and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                assert not eng.health()["ok"]
+                code, body = _get(ep.port, "/healthz")
+                assert code == 503
+                assert json.loads(body)["stale"]
+                code, body = _get(ep.port, "/readyz")
+                assert code == 503
+                assert json.loads(body)["reason"] == "unhealthy"
+            finally:
+                ep.close()
+                eng.close(timeout=0.5)
+
+    def test_readyz_flips_on_close(self, model):
+        eng = Engine(model, **_KW)
+        ep = observe.start_endpoint(eng, 0)
+        try:
+            assert _get(ep.port, "/readyz")[0] == 200
+            eng.close()
+            code, body = _get(ep.port, "/readyz")
+            assert code == 503
+            assert not json.loads(body)["ready"]
+        finally:
+            ep.close()
+            eng.close()
+
+    def test_debug_requests_shows_live_traces(self, model):
+        rng = np.random.RandomState(49)
+        prompts = _prompts(8, rng)
+        with Engine(model, **_TRACED) as eng:
+            ep = observe.start_endpoint(eng, 0)
+            try:
+                hs = [eng.submit(p, max_new_tokens=32) for p in prompts]
+                rows, deadline = [], time.monotonic() + 30
+                while not rows and time.monotonic() < deadline:
+                    code, body = _get(ep.port, "/debug/requests")
+                    assert code == 200
+                    rows = json.loads(body)
+                assert rows, "no in-flight rows observed"
+                for row in rows:
+                    assert row["phase"] in ("queued", "prefilling",
+                                            "chunk_prefill", "running",
+                                            "preempted")
+                    assert row["trace"]  # traced engine: ids everywhere
+                for h in hs:
+                    h.result(timeout=600)
+                assert _get(ep.port, "/nope")[0] == 404
+            finally:
+                ep.close()
+
+    def test_bind_failure_is_counter_not_crash(self):
+        class _T:
+            pass
+
+        c0 = profiler.counters().get("serve_http_bind_failed", 0)
+        blocker = socket.socket()
+        try:
+            blocker.bind(("", 0))
+            blocker.listen(1)
+            port = blocker.getsockname()[1]
+            assert observe.start_endpoint(_T(), port) is None
+        finally:
+            blocker.close()
+        assert profiler.counters().get("serve_http_bind_failed", 0) == c0 + 1
+
+    def test_supervisor_owns_port_across_restart(self, model):
+        """The SUPERVISOR binds the port (engines are forced to 0), so the
+        probe survives a crash restart and reports the REPLACEMENT
+        engine's young heartbeat/uptime."""
+        rng = np.random.RandomState(50)
+        prompts = _prompts(4, rng)
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        inject.arm("serve.crash:at=4")
+        with ServingSupervisor(model, watchdog_s=4.0, metrics_port=port,
+                               **_TRACED) as sup:
+            assert sup._endpoint is not None
+            assert sup._engine._endpoint is None
+            assert sup._engine.config.metrics_port == 0
+            assert _get(port, "/healthz")[0] == 200
+            _drive(sup, prompts, max_new=8)
+            assert sup.restarts == 1
+            code, body = _get(port, "/healthz")  # same port, new engine
+            assert code == 200
+            h = json.loads(body)
+            assert h["ok"]
+            # heartbeat/uptime fields are the replacement's: restarted
+            # young, strictly below the supervisor's own uptime
+            assert h["uptime_s"] < h["supervisor_uptime_s"]
+
+    def test_engine_config_endpoint_lifecycle(self, model):
+        """metrics_port on the engine config binds at construction and the
+        thread is gone after close()."""
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        eng = Engine(model, metrics_port=port, **_KW)
+        try:
+            assert eng._endpoint is not None
+            assert _get(port, "/healthz")[0] == 200
+        finally:
+            eng.close()
+        assert eng._endpoint is None
+        assert not any(t.name == "serve-metrics"
+                       for t in threading.enumerate())
+
+
+# -- cost-model drift ---------------------------------------------------------
+
+class TestDrift:
+    def test_step_eta_drift_from_warm_decode(self, model):
+        """Drift (a): warm decode steps score the shed-ETA predictor."""
+        rng = np.random.RandomState(51)
+        with Engine(model, **_TRACED) as eng:
+            # first request compiles+warms the width-1 decode bucket; the
+            # second runs warm steps, the EMA is live from its 2nd step on
+            eng.generate(rng.randint(0, 211, (5,)).tolist(),
+                         max_new_tokens=8)
+            eng.generate(rng.randint(0, 211, (6,)).tolist(),
+                         max_new_tokens=8)
+        g = observe.drift_gauges()
+        assert "step_eta" in g
+        assert g["step_eta"]["samples"] >= 1
+        assert np.isfinite(g["step_eta"]["rel_err"])
+        assert g["step_eta"]["rel_err"] >= 0.0
+        assert g["step_eta"]["actual"] > 0.0
+
+    def test_hbm_admission_drift(self, model):
+        """Drift (b): with admission armed, predicted peak is scored
+        against the realized post-step census each scheduler step."""
+        import paddle_tpu as paddle
+
+        rng = np.random.RandomState(52)
+        with _flags(FLAGS_hbm_admission="warn"):
+            # seed the preflight prediction: one lazy dispatch pays it
+            t = paddle.to_tensor(np.ones((64, 64), np.float32))
+            (t @ t).numpy()
+            from paddle_tpu.fault import memory as fmem
+
+            assert fmem.last_prediction().get("hbm_predicted_peak_bytes")
+            with Engine(model, **_TRACED) as eng:
+                _drive(eng, _prompts(2, rng), max_new=4)
+        g = observe.drift_gauges()
+        assert "hbm_admission" in g
+        assert g["hbm_admission"]["samples"] >= 1
+        assert g["hbm_admission"]["rel_err"] >= 0.0
+
+    def test_kernel_estimate_drift_from_search(self):
+        """Drift (c): an autotune search scores the cost model's ORDERING
+        against measured timings (discordant-pair fraction)."""
+        from paddle_tpu.ops.kernels import autotune, registry
+
+        sleeps = {32: 0.004, 64: 0.0, 128: 0.008}
+
+        def runner(key):
+            def make(cfg):
+                delay = sleeps[cfg["block_rows"]]
+
+                def step():
+                    time.sleep(delay)
+                    return np.zeros((2, 2), np.float32)
+                return step
+            return make
+
+        old = registry.get_kernel("fused_ce")
+        registry.register_kernel(
+            "fused_ce", defaults={"block_rows": 32},
+            space={"block_rows": (32, 64, 128)}, runner=runner)
+        autotune.clear_cache()
+        try:
+            with _flags(FLAGS_kernel_tune_samples=1,
+                        FLAGS_kernel_tune_budget_s=30.0):
+                _, _, _, searched = autotune.search(
+                    registry.get_kernel("fused_ce"),
+                    (256, 64, 512, "float32"))
+            assert searched
+        finally:
+            registry._REGISTRY["fused_ce"] = old
+            autotune.clear_cache()
+        g = observe.drift_gauges()
+        assert "kernel_estimate" in g
+        assert g["kernel_estimate"]["samples"] >= 1
+        assert 0.0 <= g["kernel_estimate"]["last_rel_err"] <= 1.0
+        assert g["kernel_estimate"]["pairs"] >= 1
+
+    def test_drift_gauges_in_prometheus_export(self):
+        observe.drift("step_eta", 0.010, 0.008)
+        text = profiler.export_metrics(format="prometheus")
+        assert "# TYPE paddle_tpu_cost_drift gauge" in text
+        assert 'paddle_tpu_cost_drift{model="step_eta"}' in text
+
+    def test_drift_math(self):
+        rel = observe.drift("x", 10.0, 5.0)
+        assert rel == 1.0
+        g = observe.drift_gauges()["x"]
+        assert g["rel_err"] == 1.0 and g["samples"] == 1
+        observe.drift("x", 5.0, 5.0)  # EMA: 0.8*1.0 + 0.2*0.0
+        g = observe.drift_gauges()["x"]
+        assert abs(g["rel_err"] - 0.8) < 1e-9
+        assert g["samples"] == 2 and g["last_rel_err"] == 0.0
+
+
+# -- health / readiness surface -----------------------------------------------
+
+class TestHealthReady:
+    def test_uptime_advances(self, model):
+        with Engine(model, **_KW) as eng:
+            u0 = eng.health()["uptime_s"]
+            assert u0 >= 0.0
+            time.sleep(0.05)
+            assert eng.health()["uptime_s"] > u0
+
+    def test_last_recovery_age_after_adopt(self, model):
+        rng = np.random.RandomState(53)
+        prompts = _prompts(2, rng)
+        old = Engine(model, **_KW)
+        try:
+            hs = [old.submit(p, max_new_tokens=6) for p in prompts]
+            snap = old.handoff()
+            with Engine(model, **_KW) as new:
+                assert new.health()["last_recovery"] == {"mode": "none"}
+                new.adopt(snap)
+                for h in hs:
+                    h.result(timeout=600)
+                lr = new.health()["last_recovery"]
+                assert lr["mode"] == "reattach"
+                assert lr["age_s"] >= 0.0
+                assert "t" not in lr  # raw monotonic stamp never exported
+        finally:
+            old.close()
+
+    def test_readiness_is_truthy_dict(self, model):
+        eng = Engine(model, **_KW)
+        try:
+            r = eng.ready()
+            assert isinstance(r, Readiness) and isinstance(r, dict)
+            assert bool(r) and r["reason"] is None
+            assert r["uptime_s"] >= 0.0
+            json.dumps(r)  # the /readyz body must be JSON-able
+        finally:
+            eng.close()
+        r = eng.ready()
+        assert not r
+        assert r["reason"] == "unhealthy"
+
+
+# -- inert when unconfigured --------------------------------------------------
+
+class TestInertTripwire:
+    def test_flag_off_engine_never_touches_observe(self, model, monkeypatch):
+        """Flag-off: no observe state, no endpoint thread — and every hook
+        monkeypatch-exploded proves no code path can reach one."""
+        rng = np.random.RandomState(54)
+
+        def _explode(*a, **k):
+            raise AssertionError("observe hook reached with tracing off")
+
+        for name in ("on_submit", "on_admit", "on_shed", "on_prefix_match",
+                     "on_cow", "on_relay", "on_tokens", "on_done",
+                     "drift", "drift_value"):
+            monkeypatch.setattr(observe, name, _explode)
+        with Engine(model, **_KW) as eng:
+            assert eng._obs is None
+            assert eng._endpoint is None
+            outs = _drive(eng, _prompts(4, rng), max_new=4)
+        assert all(len(o) > 4 for o in outs)
+        assert observe._book is None  # no TraceBook was ever created
+        assert not any(t.name == "serve-metrics"
+                       for t in threading.enumerate())
+
+    def test_trace_flag_arms_engine(self, model):
+        with _flags(FLAGS_serve_trace=True):
+            with Engine(model, **_KW) as eng:
+                assert eng._obs is not None
+        with Engine(model, **_KW) as eng:
+            assert eng._obs is None
